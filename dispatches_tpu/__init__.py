@@ -1,0 +1,41 @@
+"""dispatches_tpu — a TPU-native hybrid-energy-systems design & dispatch framework.
+
+A ground-up JAX/XLA re-design of the capability surface of DISPATCHES
+(the DOE GMLC "Design Integration and Synthesis Platform to Advance Tightly
+Coupled Hybrid Energy Systems"): declarative steady-state process flowsheets
+for hybrid plants, stacked over a leading time axis into multiperiod
+price-taker optimizations against LMP signals, solved by a batched
+primal-dual interior-point method on TPU (``jax.vmap`` over LMP scenarios,
+``shard_map`` over the device mesh), and embedded in a bidder/tracker
+double-loop market co-simulation.
+
+Where the reference (``/root/reference``, see SURVEY.md) clones Pyomo/IDAES
+blocks per time step and hands each NLP to single-threaded IPOPT via NL
+files, this framework lowers a flowsheet ONCE to pure-JAX residual
+functions with a leading time axis; ``jax.grad``/``jax.jacfwd`` supply
+exact KKT quantities (replacing the AMPL Solver Library), and the whole
+solve is jit-compiled, batched, and sharded.
+
+Numerics note: interior-point solves need float64 (condition numbers grow
+like 1/mu as the barrier parameter shrinks), so importing this package
+enables JAX x64 mode unless DISPATCHES_TPU_NO_X64 is set.
+"""
+
+import os
+
+if not os.environ.get("DISPATCHES_TPU_NO_X64"):
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec  # noqa: E402
+from dispatches_tpu.core.compile import CompiledNLP  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Flowsheet",
+    "UnitModel",
+    "VarSpec",
+    "CompiledNLP",
+]

@@ -1,0 +1,4 @@
+from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec, Port
+from dispatches_tpu.core.compile import CompiledNLP
+
+__all__ = ["Flowsheet", "UnitModel", "VarSpec", "Port", "CompiledNLP"]

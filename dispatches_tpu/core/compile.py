@@ -1,0 +1,151 @@
+"""Lowering: Flowsheet -> flat NLP over pure-JAX callables.
+
+The reference's equivalent step is Pyomo writing an AMPL NL file for every
+solve and IPOPT reading derivatives from the AMPL Solver Library (SURVEY.md
+§2.6, §3.1 "HOT LOOP #2").  Here lowering happens once, producing three
+jit-compatible callables
+
+    objective(x, params) -> scalar
+    eq(x, params)        -> (m_eq,)   residuals, feasible iff == 0
+    ineq(x, params)      -> (m_ineq,) residuals, feasible iff <= 0
+
+over a flat decision vector ``x`` (fixed variables are injected through the
+``params`` pytree, so sweeping a fixed design value or an LMP signal needs
+no recompilation and batches under ``vmap``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.core.graph import Flowsheet, Vals
+
+
+class CompiledNLP:
+    def __init__(self, fs: Flowsheet, objective: Optional[Callable] = None, sense: str = "min"):
+        self.fs = fs
+        self.sense = sense
+        if sense not in ("min", "max"):
+            raise ValueError("sense must be 'min' or 'max'")
+        self._objective_fn = objective
+
+        # --- variable layout -----------------------------------------
+        self.free_names: List[str] = [n for n, s in fs.var_specs.items() if not s.fixed]
+        self.fixed_names: List[str] = [n for n, s in fs.var_specs.items() if s.fixed]
+
+        slices: Dict[str, Tuple[int, int, Tuple[int, ...]]] = {}
+        off = 0
+        for n in self.free_names:
+            sz = int(np.prod(fs.var_specs[n].shape, dtype=int)) if fs.var_specs[n].shape else 1
+            slices[n] = (off, off + sz, fs.var_specs[n].shape)
+            off += sz
+        self._slices = slices
+        self.n = off
+
+        self.x0 = np.concatenate(
+            [fs.var_specs[n].init_array().ravel() for n in self.free_names]
+        ) if self.free_names else np.zeros(0)
+        self.lb = np.concatenate(
+            [fs.var_specs[n].lb_array().ravel() for n in self.free_names]
+        ) if self.free_names else np.zeros(0)
+        self.ub = np.concatenate(
+            [fs.var_specs[n].ub_array().ravel() for n in self.free_names]
+        ) if self.free_names else np.zeros(0)
+
+        # --- constraint layout (shapes probed once, eagerly) ---------
+        self._eq = [c for c in fs.constraints if c.kind == "eq"]
+        self._ineq = [c for c in fs.constraints if c.kind == "ineq"]
+
+        p0 = self.default_params()
+        v0 = self._vals(jnp.asarray(self.x0), p0)
+        pv0 = Vals({k: jnp.asarray(v) for k, v in fs.params.items()})
+
+        def _probe(cons):
+            sl, o = {}, 0
+            for c in cons:
+                out = np.asarray(c.fn(v0, pv0))
+                sz = int(out.size)
+                sl[c.name] = (o, o + sz)
+                o += sz
+            return sl, o
+
+        self.eq_slices, self.m_eq = _probe(self._eq)
+        self.ineq_slices, self.m_ineq = _probe(self._ineq)
+
+    # ------------------------------------------------------------------
+
+    def default_params(self) -> Dict[str, Dict[str, np.ndarray]]:
+        fs = self.fs
+        return {
+            "p": {k: np.asarray(v) for k, v in fs.params.items()},
+            "fixed": {n: np.asarray(fs.var_specs[n].fixed_value) for n in self.fixed_names},
+        }
+
+    def _vals(self, x: jnp.ndarray, params) -> Vals:
+        d: Dict[str, jnp.ndarray] = {}
+        for n, (a, b, shape) in self._slices.items():
+            d[n] = x[a:b].reshape(shape)
+        for n in self.fixed_names:
+            d[n] = jnp.asarray(params["fixed"][n])
+        return Vals(d)
+
+    # --- the three lowered callables ---------------------------------
+
+    def objective(self, x: jnp.ndarray, params) -> jnp.ndarray:
+        if self._objective_fn is None:
+            return jnp.asarray(0.0, dtype=x.dtype)
+        v = self._vals(x, params)
+        p = Vals(params["p"])
+        val = self._objective_fn(v, p)
+        return -val if self.sense == "max" else val
+
+    def user_objective(self, x: jnp.ndarray, params) -> jnp.ndarray:
+        """Objective in the user's declared sense (max problems are negated
+        internally for the minimizing solver)."""
+        val = self.objective(x, params)
+        return -val if self.sense == "max" else val
+
+    def eq(self, x: jnp.ndarray, params) -> jnp.ndarray:
+        if not self._eq:
+            return jnp.zeros((0,), dtype=x.dtype)
+        v = self._vals(x, params)
+        p = Vals(params["p"])
+        return jnp.concatenate([jnp.ravel(c.fn(v, p)) for c in self._eq])
+
+    def ineq(self, x: jnp.ndarray, params) -> jnp.ndarray:
+        if not self._ineq:
+            return jnp.zeros((0,), dtype=x.dtype)
+        v = self._vals(x, params)
+        p = Vals(params["p"])
+        return jnp.concatenate([jnp.ravel(c.fn(v, p)) for c in self._ineq])
+
+    # --- solution helpers --------------------------------------------
+
+    def unravel(self, x) -> Dict[str, np.ndarray]:
+        x = np.asarray(x)
+        out = {}
+        for n, (a, b, shape) in self._slices.items():
+            out[n] = x[a:b].reshape(shape)
+        for n in self.fixed_names:
+            out[n] = np.asarray(self.fs.var_specs[n].fixed_value)
+        return out
+
+    def constraint_report(self, x, params, tol: float = 1e-6) -> Dict[str, float]:
+        """Max violation per constraint block — the analog of the reference's
+        ``log_infeasible_constraints`` diagnostics
+        (``wind_battery_PEM_tank_turbine_LMP.py:417-427``)."""
+        r_eq = np.asarray(self.eq(jnp.asarray(x), params))
+        r_in = np.asarray(self.ineq(jnp.asarray(x), params))
+        out = {}
+        for name, (a, b) in self.eq_slices.items():
+            viol = float(np.max(np.abs(r_eq[a:b]))) if b > a else 0.0
+            if viol > tol:
+                out[name] = viol
+        for name, (a, b) in self.ineq_slices.items():
+            viol = float(np.max(r_in[a:b])) if b > a else 0.0
+            if viol > tol:
+                out[name] = viol
+        return out

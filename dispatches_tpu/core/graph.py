@@ -1,0 +1,282 @@
+"""Time-indexed flowsheet graph: the TPU-native replacement for the
+Pyomo/IDAES modeling layer consumed by the reference.
+
+Design (vs reference, see SURVEY.md L0/L1):
+
+* The reference builds one Pyomo block per time step and clones it across
+  the horizon (``wind_battery_LMP.py:144-166`` in the reference), producing
+  a sparse symbolic NLP that is serialized to an AMPL NL file per solve.
+  Here every time-indexed quantity is ONE array with a leading time axis
+  of length ``horizon``; constraints are pure-JAX residual functions
+  evaluated vectorized over that axis, and time coupling (storage state
+  carry-over) is expressed as shifted-slice equalities — no cloning, no
+  serialization, traced once under ``jit``.
+
+* Pyomo ``Var`` -> :class:`VarSpec` (array-shaped, with bounds and init).
+  ``Param(mutable=True)`` -> entries of a params pytree, batchable under
+  ``vmap`` (this is how one compiled model sweeps 366 LMP signals).
+  ``Constraint`` -> residual callables ``fn(v, p) -> array`` registered as
+  equalities (``== 0``) or inequalities (``<= 0``).
+  ``Port``/``Arc`` + ``expand_arcs`` -> :class:`Port` dicts matched key-by-key
+  into equality residuals at :meth:`Flowsheet.connect`.
+  ``Var.fix()`` -> :meth:`Flowsheet.fix`, which removes the variable from
+  the decision vector at compile time and injects its value through the
+  params pytree (so fixed values can still be swept without recompiling).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+Scalar = Union[float, int]
+
+_INF = math.inf
+
+
+@dataclass
+class VarSpec:
+    """A decision variable: scalar (shape ``()``) or time-indexed (``(T,)``)
+    or general array-shaped (e.g. ``(T, nx)`` for 1-D spatial discretizations).
+    """
+
+    name: str
+    shape: Tuple[int, ...]
+    lb: Union[Scalar, np.ndarray] = -_INF
+    ub: Union[Scalar, np.ndarray] = _INF
+    init: Union[Scalar, np.ndarray] = 0.0
+    fixed: bool = False
+    fixed_value: Optional[Union[Scalar, np.ndarray]] = None
+
+    def init_array(self) -> np.ndarray:
+        return np.broadcast_to(np.asarray(self.init, dtype=np.float64), self.shape).copy()
+
+    def lb_array(self) -> np.ndarray:
+        return np.broadcast_to(np.asarray(self.lb, dtype=np.float64), self.shape).copy()
+
+    def ub_array(self) -> np.ndarray:
+        return np.broadcast_to(np.asarray(self.ub, dtype=np.float64), self.shape).copy()
+
+
+class Vals:
+    """Read-only view of variable/parameter values inside residual functions.
+
+    Supports ``v["unit.var"]`` and attribute-free unit scoping via
+    ``v.unit("battery")["soc"]``.
+    """
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: Dict[str, Array]):
+        self._d = d
+
+    def __getitem__(self, name: str) -> Array:
+        return self._d[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._d
+
+    def get(self, name: str, default=None):
+        return self._d.get(name, default)
+
+    def scoped(self, prefix: str) -> "ScopedVals":
+        return ScopedVals(self._d, prefix)
+
+
+class ScopedVals:
+    __slots__ = ("_d", "_p")
+
+    def __init__(self, d: Dict[str, Array], prefix: str):
+        self._d = d
+        self._p = prefix
+
+    def __getitem__(self, name: str) -> Array:
+        return self._d[f"{self._p}.{name}"]
+
+
+@dataclass
+class Port:
+    """A named bundle of variable references — the connection surface of a
+    unit model.  ``keys`` maps stream-member names (e.g. ``"electricity"``,
+    ``"flow_mol"``, ``"temperature"``) to fully-qualified variable names.
+    """
+
+    name: str
+    keys: Dict[str, str] = field(default_factory=dict)
+
+    def add(self, member: str, varname: str) -> None:
+        self.keys[member] = varname
+
+
+@dataclass
+class _Constraint:
+    name: str
+    fn: Callable  # fn(v: Vals, p: Vals) -> Array
+    kind: str  # "eq" (== 0) or "ineq" (<= 0)
+
+
+class Flowsheet:
+    """Container for a flowsheet over a fixed horizon of ``horizon`` periods.
+
+    The reference's ``FlowsheetBlock(dynamic=False)`` holds a single time
+    point and gets cloned per period; here the flowsheet IS the whole
+    horizon (reference: ``idaes`` FlowsheetBlock usage throughout, e.g.
+    ``RE_flowsheet.py:337-419``).
+    """
+
+    def __init__(self, horizon: int = 1, dt_hr: float = 1.0):
+        self.horizon = int(horizon)
+        self.dt_hr = float(dt_hr)
+        self.units: Dict[str, "UnitModel"] = {}
+        self.var_specs: Dict[str, VarSpec] = {}
+        self.params: Dict[str, np.ndarray] = {}
+        self.constraints: List[_Constraint] = []
+        self._n_anon = 0
+
+    # ---------------- variables / params ----------------
+
+    def add_var(
+        self,
+        name: str,
+        shape: Union[Tuple[int, ...], str, None] = "time",
+        lb: Union[Scalar, np.ndarray] = -_INF,
+        ub: Union[Scalar, np.ndarray] = _INF,
+        init: Union[Scalar, np.ndarray] = 0.0,
+    ) -> str:
+        if shape == "time":
+            shape = (self.horizon,)
+        elif shape is None:
+            shape = ()
+        if name in self.var_specs:
+            raise ValueError(f"duplicate variable {name!r}")
+        self.var_specs[name] = VarSpec(name, tuple(shape), lb, ub, init)
+        return name
+
+    def add_param(self, name: str, value) -> str:
+        self.params[name] = np.asarray(value, dtype=np.float64)
+        return name
+
+    def fix(self, name: str, value=None) -> None:
+        spec = self.var_specs[name]
+        spec.fixed = True
+        spec.fixed_value = np.broadcast_to(
+            np.asarray(spec.init if value is None else value, dtype=np.float64), spec.shape
+        ).copy()
+
+    def unfix(self, name: str) -> None:
+        spec = self.var_specs[name]
+        spec.fixed = False
+        spec.fixed_value = None
+
+    def is_fixed(self, name: str) -> bool:
+        return self.var_specs[name].fixed
+
+    def set_init(self, name: str, value) -> None:
+        self.var_specs[name].init = value
+
+    # ---------------- constraints ----------------
+
+    def add_eq(self, name: str, fn: Callable) -> None:
+        self.constraints.append(_Constraint(name, fn, "eq"))
+
+    def add_ineq(self, name: str, fn: Callable) -> None:
+        """Register ``fn(v, p) <= 0``."""
+        self.constraints.append(_Constraint(name, fn, "ineq"))
+
+    def deactivate(self, name: str) -> None:
+        self.constraints = [c for c in self.constraints if c.name != name]
+
+    def has_constraint(self, name: str) -> bool:
+        return any(c.name == name for c in self.constraints)
+
+    # ---------------- connections ----------------
+
+    def connect(self, src: Port, dst: Port, name: Optional[str] = None) -> None:
+        """Equate every shared stream member of two ports (the reference's
+        ``Arc`` + ``TransformationFactory("network.expand_arcs")``,
+        ``RE_flowsheet.py:419``)."""
+        shared = [k for k in src.keys if k in dst.keys]
+        if not shared:
+            raise ValueError(f"ports {src.name} and {dst.name} share no stream members")
+        cname = name or f"arc_{src.name}__{dst.name}"
+        pairs = [(src.keys[k], dst.keys[k]) for k in shared]
+
+        def residual(v, p, _pairs=tuple(pairs)):
+            return jnp.concatenate(
+                [jnp.ravel(v[a] - v[b]) for a, b in _pairs]
+            )
+
+        self.add_eq(cname, residual)
+
+    # ---------------- unit registry ----------------
+
+    def register_unit(self, unit: "UnitModel") -> None:
+        if unit.name in self.units:
+            raise ValueError(f"duplicate unit {unit.name!r}")
+        self.units[unit.name] = unit
+
+    # ---------------- lowering ----------------
+
+    def compile(self, objective: Optional[Callable] = None, sense: str = "min"):
+        from dispatches_tpu.core.compile import CompiledNLP
+
+        return CompiledNLP(self, objective=objective, sense=sense)
+
+
+class UnitModel:
+    """Base class for unit models (reference: IDAES ``UnitModelBlockData``
+    with ``declare_process_block_class``; SURVEY.md L1/L2).
+
+    A subclass's ``__init__`` should call ``super().__init__(fs, name)`` and
+    then declare variables/constraints/ports on ``self.fs`` using
+    ``self.v("local")`` to build fully-qualified names.
+    """
+
+    def __init__(self, fs: Flowsheet, name: str):
+        self.fs = fs
+        self.name = name
+        self.ports: Dict[str, Port] = {}
+        fs.register_unit(self)
+
+    # naming helpers -------------------------------------------------
+
+    def v(self, local: str) -> str:
+        return f"{self.name}.{local}"
+
+    def add_var(self, local: str, **kw) -> str:
+        return self.fs.add_var(self.v(local), **kw)
+
+    def add_param(self, local: str, value) -> str:
+        return self.fs.add_param(self.v(local), value)
+
+    def add_eq(self, local: str, fn: Callable) -> None:
+        self.fs.add_eq(self.v(local), fn)
+
+    def add_ineq(self, local: str, fn: Callable) -> None:
+        self.fs.add_ineq(self.v(local), fn)
+
+    def add_port(self, local: str, members: Dict[str, str]) -> Port:
+        port = Port(self.v(local), dict(members))
+        self.ports[local] = port
+        return port
+
+    def port(self, local: str) -> Port:
+        return self.ports[local]
+
+
+def tshift(arr: Array, initial: Array) -> Array:
+    """``[initial, arr[0], ..., arr[T-2]]`` — the previous-period value of a
+    time-indexed array, with ``initial`` (a scalar var or param) at t=0.
+
+    This one-liner is the TPU-native replacement for the reference's
+    linking-constraint machinery (``MultiPeriodModel`` linking pairs,
+    ``wind_battery_LMP.py:22-37``): storage carry-over becomes a shifted
+    slice instead of per-period ``initial_*`` variables plus equality
+    constraints between cloned blocks.
+    """
+    return jnp.concatenate([jnp.reshape(initial, (1,)), arr[:-1]])

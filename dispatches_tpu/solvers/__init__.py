@@ -1,0 +1,4 @@
+from dispatches_tpu.solvers.ipm import IPMOptions, IPMResult, make_ipm_solver, solve_nlp
+from dispatches_tpu.solvers.factory import SolverFactory
+
+__all__ = ["IPMOptions", "IPMResult", "make_ipm_solver", "solve_nlp", "SolverFactory"]

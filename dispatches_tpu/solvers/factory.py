@@ -1,0 +1,62 @@
+"""SolverFactory-style entry point (BASELINE north star: the TPU backend is
+"gated behind Pyomo's SolverFactory plugin interface"; reference usage e.g.
+``wind_battery_LMP.py:255`` ``SolverFactory("cbc").solve(m)``).
+
+Here the factory hands out solver objects with a ``solve(nlp, params=...)``
+method so drivers read like the reference's, while the execution path is
+the batched JAX IPM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
+
+
+class _IPMSolver:
+    name = "ipm"
+
+    def __init__(self, **options):
+        self.options = options
+
+    def solve(self, nlp, params=None, x0=None, tee: bool = False, **opt_overrides):
+        opts = dict(self.options)
+        opts.update(opt_overrides)
+        ipm_opts = IPMOptions(**opts) if opts else IPMOptions()
+        params = nlp.default_params() if params is None else params
+        solver = jax.jit(make_ipm_solver(nlp, ipm_opts))
+        res = solver(params) if x0 is None else solver(params, x0)
+        if tee:
+            print(
+                f"[dispatches_tpu.ipm] iters={int(res.iterations)} "
+                f"kkt_error={float(res.kkt_error):.3e} converged={bool(res.converged)} "
+                f"obj={float(res.obj):.8g}"
+            )
+        return res
+
+
+_REGISTRY = {
+    "ipm": _IPMSolver,
+    # aliases so reference-style driver code ports verbatim: both of the
+    # reference's workhorse solvers map onto the same TPU IPM kernel
+    # (CBC handled LPs, IPOPT handled NLPs — one kernel covers both here).
+    "ipopt": _IPMSolver,
+    "cbc": _IPMSolver,
+}
+
+
+def SolverFactory(name: str, **options):
+    try:
+        cls = _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown solver {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**options)
+
+
+def register_solver(name: str, cls) -> None:
+    _REGISTRY[name.lower()] = cls

@@ -1,0 +1,19 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding paths are exercised without TPU hardware (the driver
+separately dry-runs the multichip path; bench.py uses the real chip).
+
+The hardware tunnel in this environment pins JAX_PLATFORMS in a way that
+survives os.environ writes, so the platform is forced through jax.config
+(effective as long as no backend has been initialized yet)."""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
