@@ -1,0 +1,120 @@
+"""Benchmark: scenario-batched price-taker solves on TPU.
+
+North-star metric (BASELINE.json): throughput of 24-h wind+battery
+price-taker solves across an LMP-scenario batch — the workload the
+reference runs as one serial CBC/IPOPT subprocess per scenario
+(``wind_battery_LMP.py:255``, SURVEY.md §3.1).  The baseline denominator
+is the measured single-scenario solve time on the same machine
+(batch=1, the reference's serial pattern); the headline value is
+batched solves/second, ``vs_baseline`` = speedup over serial.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from dispatches_tpu import Flowsheet
+    from dispatches_tpu.core.graph import tshift
+    import jax.numpy as jnp
+    from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+    T = 24
+    N_SCENARIOS = 366  # the annual-sweep batch (SURVEY.md §2.7)
+
+    fs = Flowsheet(horizon=T)
+    fs.add_var("wind_elec", lb=0, ub=1e6, scale=1e3)
+    fs.add_var("grid", lb=0, ub=1e6, scale=1e3)
+    fs.add_var("batt_in", lb=0, ub=1e6, scale=1e3)
+    fs.add_var("batt_out", lb=0, ub=1e6, scale=1e3)
+    fs.add_var("soc", lb=0, ub=4e6, scale=1e3)
+    fs.add_var("soc0", shape=(), lb=0)
+    fs.fix("soc0", 0.0)
+    fs.add_param("lmp", np.full(T, 0.02))
+    fs.add_param("wind_cap_cf", np.full(T, 400e3))
+    fs.add_eq(
+        "power_balance",
+        lambda v, p: v["wind_elec"] - v["grid"] - v["batt_in"],
+    )
+    fs.add_eq(
+        "soc_evolution",
+        lambda v, p: v["soc"]
+        - tshift(v["soc"], v["soc0"])
+        - 0.95 * v["batt_in"]
+        + v["batt_out"] / 0.95,
+    )
+    fs.add_ineq("wind_cf", lambda v, p: v["wind_elec"] - p["wind_cap_cf"])
+    fs.add_ineq("batt_p_in", lambda v, p: v["batt_in"] - 300e3)
+    fs.add_ineq("batt_p_out", lambda v, p: v["batt_out"] - 300e3)
+    fs.add_eq("periodic", lambda v, p: v["soc"][-1] - v["soc0"])
+    nlp = fs.compile(
+        objective=lambda v, p: jnp.sum(p["lmp"] * (v["grid"] + v["batt_out"])),
+        sense="max",
+    )
+
+    solver = make_ipm_solver(nlp, IPMOptions(max_iter=60, tol=1e-8))
+
+    rng = np.random.default_rng(0)
+    lmps = 0.02 + 0.015 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (N_SCENARIOS, 1)))
+        / 24
+    )
+    cfs = 400e3 * (0.4 + 0.6 * rng.random((N_SCENARIOS, T)))
+
+    params = nlp.default_params()
+    in_axes = ({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},)
+    batched = {
+        "p": {"lmp": lmps, "wind_cap_cf": cfs},
+        "fixed": params["fixed"],
+    }
+
+    vsolve = jax.jit(jax.vmap(solver, in_axes=in_axes))
+    single = jax.jit(solver)
+
+    # warm up compiles
+    p1 = {"p": {"lmp": lmps[0], "wind_cap_cf": cfs[0]}, "fixed": params["fixed"]}
+    single(p1).obj.block_until_ready()
+    vsolve(batched).obj.block_until_ready()
+
+    # serial baseline: one scenario at a time (the reference's pattern)
+    n_serial = 16
+    t0 = time.perf_counter()
+    for i in range(n_serial):
+        pi = {
+            "p": {"lmp": lmps[i % N_SCENARIOS], "wind_cap_cf": cfs[i % N_SCENARIOS]},
+            "fixed": params["fixed"],
+        }
+        single(pi).obj.block_until_ready()
+    serial_per_solve = (time.perf_counter() - t0) / n_serial
+
+    # batched throughput
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        vsolve(batched).obj.block_until_ready()
+    batched_per_sweep = (time.perf_counter() - t0) / reps
+    solves_per_sec = N_SCENARIOS / batched_per_sweep
+    speedup = serial_per_solve / (batched_per_sweep / N_SCENARIOS)
+
+    print(
+        json.dumps(
+            {
+                "metric": "pricetaker_24h_solves_per_sec_366batch",
+                "value": round(solves_per_sec, 2),
+                "unit": "solves/s",
+                "vs_baseline": round(speedup, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

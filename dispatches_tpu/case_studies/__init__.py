@@ -1,0 +1,3 @@
+"""Case studies: renewables (wind/battery/PEM/H2), nuclear, fossil —
+capability counterparts of the reference's ``dispatches/case_studies``.
+"""

@@ -1,0 +1,9 @@
+"""Renewables case study (reference ``case_studies/renewables_case``):
+wind + battery + PEM + H2 tank + H2 turbine hybrid plant, price-taker
+multiperiod optimization and double-loop market participation.
+"""
+
+from dispatches_tpu.case_studies.renewables.flowsheet import create_model
+from dispatches_tpu.case_studies.renewables.wind_battery_lmp import (
+    wind_battery_optimize,
+)

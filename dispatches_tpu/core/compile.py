@@ -45,15 +45,23 @@ class CompiledNLP:
         self._slices = slices
         self.n = off
 
-        self.x0 = np.concatenate(
-            [fs.var_specs[n].init_array().ravel() for n in self.free_names]
-        ) if self.free_names else np.zeros(0)
-        self.lb = np.concatenate(
-            [fs.var_specs[n].lb_array().ravel() for n in self.free_names]
-        ) if self.free_names else np.zeros(0)
-        self.ub = np.concatenate(
-            [fs.var_specs[n].ub_array().ravel() for n in self.free_names]
-        ) if self.free_names else np.zeros(0)
+        # The decision vector holds SCALED values (x_phys = x * var_scale);
+        # bounds/inits are scaled here and residuals see physical values
+        # via _vals.  This keeps the KKT matrix well-conditioned when vars
+        # span many orders of magnitude (Pa next to K next to mol).
+        def _cat(fn):
+            if not self.free_names:
+                return np.zeros(0)
+            return np.concatenate(
+                [fn(fs.var_specs[n]).ravel() for n in self.free_names]
+            )
+
+        self.var_scale = _cat(
+            lambda s: np.full(s.shape if s.shape else (1,), s.scale)
+        )
+        self.x0 = _cat(lambda s: s.init_array()) / self.var_scale
+        self.lb = _cat(lambda s: s.lb_array()) / self.var_scale
+        self.ub = _cat(lambda s: s.ub_array()) / self.var_scale
 
         # --- constraint layout (shapes probed once, eagerly) ---------
         self._eq = [c for c in fs.constraints if c.kind == "eq"]
@@ -87,7 +95,7 @@ class CompiledNLP:
     def _vals(self, x: jnp.ndarray, params) -> Vals:
         d: Dict[str, jnp.ndarray] = {}
         for n, (a, b, shape) in self._slices.items():
-            d[n] = x[a:b].reshape(shape)
+            d[n] = (x[a:b] * self.var_scale[a:b]).reshape(shape)
         for n in self.fixed_names:
             d[n] = jnp.asarray(params["fixed"][n])
         return Vals(d)
@@ -113,14 +121,18 @@ class CompiledNLP:
             return jnp.zeros((0,), dtype=x.dtype)
         v = self._vals(x, params)
         p = Vals(params["p"])
-        return jnp.concatenate([jnp.ravel(c.fn(v, p)) for c in self._eq])
+        return jnp.concatenate(
+            [c.scale * jnp.ravel(c.fn(v, p)) for c in self._eq]
+        )
 
     def ineq(self, x: jnp.ndarray, params) -> jnp.ndarray:
         if not self._ineq:
             return jnp.zeros((0,), dtype=x.dtype)
         v = self._vals(x, params)
         p = Vals(params["p"])
-        return jnp.concatenate([jnp.ravel(c.fn(v, p)) for c in self._ineq])
+        return jnp.concatenate(
+            [c.scale * jnp.ravel(c.fn(v, p)) for c in self._ineq]
+        )
 
     # --- solution helpers --------------------------------------------
 
@@ -128,24 +140,27 @@ class CompiledNLP:
         x = np.asarray(x)
         out = {}
         for n, (a, b, shape) in self._slices.items():
-            out[n] = x[a:b].reshape(shape)
+            out[n] = (x[a:b] * np.asarray(self.var_scale[a:b])).reshape(shape)
         for n in self.fixed_names:
             out[n] = np.asarray(self.fs.var_specs[n].fixed_value)
         return out
 
     def constraint_report(self, x, params, tol: float = 1e-6) -> Dict[str, float]:
-        """Max violation per constraint block — the analog of the reference's
+        """Max PHYSICAL violation per constraint block (residual scales
+        divided back out) — the analog of the reference's
         ``log_infeasible_constraints`` diagnostics
         (``wind_battery_PEM_tank_turbine_LMP.py:417-427``)."""
         r_eq = np.asarray(self.eq(jnp.asarray(x), params))
         r_in = np.asarray(self.ineq(jnp.asarray(x), params))
         out = {}
-        for name, (a, b) in self.eq_slices.items():
-            viol = float(np.max(np.abs(r_eq[a:b]))) if b > a else 0.0
+        for c in self._eq:
+            a, b = self.eq_slices[c.name]
+            viol = float(np.max(np.abs(r_eq[a:b]))) / c.scale if b > a else 0.0
             if viol > tol:
-                out[name] = viol
-        for name, (a, b) in self.ineq_slices.items():
-            viol = float(np.max(r_in[a:b])) if b > a else 0.0
+                out[c.name] = viol
+        for c in self._ineq:
+            a, b = self.ineq_slices[c.name]
+            viol = float(np.max(r_in[a:b])) / c.scale if b > a else 0.0
             if viol > tol:
-                out[name] = viol
+                out[c.name] = viol
         return out

@@ -52,6 +52,8 @@ class VarSpec:
     init: Union[Scalar, np.ndarray] = 0.0
     fixed: bool = False
     fixed_value: Optional[Union[Scalar, np.ndarray]] = None
+    scale: float = 1.0  # typical magnitude; the solver works on x/scale
+    # (variable scaling — the role of IDAES iscale set_scaling_factor)
 
     def init_array(self) -> np.ndarray:
         return np.broadcast_to(np.asarray(self.init, dtype=np.float64), self.shape).copy()
@@ -118,6 +120,9 @@ class _Constraint:
     name: str
     fn: Callable  # fn(v: Vals, p: Vals) -> Array
     kind: str  # "eq" (== 0) or "ineq" (<= 0)
+    scale: float = 1.0  # residual multiplier (the role of IDAES iscale
+    # constraint scaling factors, e.g. hydrogen_tank.py:470-597 in the
+    # reference) — keeps the KKT system well-conditioned in SI units
 
 
 class Flowsheet:
@@ -147,6 +152,7 @@ class Flowsheet:
         lb: Union[Scalar, np.ndarray] = -_INF,
         ub: Union[Scalar, np.ndarray] = _INF,
         init: Union[Scalar, np.ndarray] = 0.0,
+        scale: float = 1.0,
     ) -> str:
         if shape == "time":
             shape = (self.horizon,)
@@ -154,8 +160,17 @@ class Flowsheet:
             shape = ()
         if name in self.var_specs:
             raise ValueError(f"duplicate variable {name!r}")
-        self.var_specs[name] = VarSpec(name, tuple(shape), lb, ub, init)
+        if scale <= 0:
+            raise ValueError("var scale must be positive")
+        self.var_specs[name] = VarSpec(
+            name, tuple(shape), lb, ub, init, scale=scale
+        )
         return name
+
+    def set_scale(self, name: str, scale: float) -> None:
+        if scale <= 0:
+            raise ValueError("var scale must be positive")
+        self.var_specs[name].scale = scale
 
     def add_param(self, name: str, value) -> str:
         self.params[name] = np.asarray(value, dtype=np.float64)
@@ -179,14 +194,30 @@ class Flowsheet:
     def set_init(self, name: str, value) -> None:
         self.var_specs[name].init = value
 
+    def set_bounds(self, name: str, lb=None, ub=None) -> None:
+        spec = self.var_specs[name]
+        if lb is not None:
+            spec.lb = lb
+        if ub is not None:
+            spec.ub = ub
+
     # ---------------- constraints ----------------
 
-    def add_eq(self, name: str, fn: Callable) -> None:
-        self.constraints.append(_Constraint(name, fn, "eq"))
+    def _check_new_constraint(self, name: str, scale: float) -> None:
+        if scale <= 0:
+            raise ValueError("constraint scale must be positive")
+        if any(c.name == name for c in self.constraints):
+            raise ValueError(f"duplicate constraint {name!r}")
 
-    def add_ineq(self, name: str, fn: Callable) -> None:
-        """Register ``fn(v, p) <= 0``."""
-        self.constraints.append(_Constraint(name, fn, "ineq"))
+    def add_eq(self, name: str, fn: Callable, scale: float = 1.0) -> None:
+        self._check_new_constraint(name, scale)
+        self.constraints.append(_Constraint(name, fn, "eq", scale))
+
+    def add_ineq(self, name: str, fn: Callable, scale: float = 1.0) -> None:
+        """Register ``fn(v, p) <= 0``.  ``scale`` must be positive (a
+        negative scale would flip the inequality)."""
+        self._check_new_constraint(name, scale)
+        self.constraints.append(_Constraint(name, fn, "ineq", scale))
 
     def deactivate(self, name: str) -> None:
         self.constraints = [c for c in self.constraints if c.name != name]
@@ -254,11 +285,11 @@ class UnitModel:
     def add_param(self, local: str, value) -> str:
         return self.fs.add_param(self.v(local), value)
 
-    def add_eq(self, local: str, fn: Callable) -> None:
-        self.fs.add_eq(self.v(local), fn)
+    def add_eq(self, local: str, fn: Callable, scale: float = 1.0) -> None:
+        self.fs.add_eq(self.v(local), fn, scale)
 
-    def add_ineq(self, local: str, fn: Callable) -> None:
-        self.fs.add_ineq(self.v(local), fn)
+    def add_ineq(self, local: str, fn: Callable, scale: float = 1.0) -> None:
+        self.fs.add_ineq(self.v(local), fn, scale)
 
     def add_port(self, local: str, members: Dict[str, str]) -> Port:
         port = Port(self.v(local), dict(members))

@@ -1,0 +1,40 @@
+"""TPU-native unit-model library.
+
+Capability counterpart of the reference's ``dispatches/unit_models``
+(public surface ``dispatches/unit_models/__init__.py:15-24``): the same
+ten unit models, re-designed as time-axis-vectorized constraint emitters
+on a :class:`dispatches_tpu.core.graph.Flowsheet` instead of per-period
+Pyomo blocks.
+"""
+
+from dispatches_tpu.models.base import StateBundle
+from dispatches_tpu.models.battery import BatteryStorage
+from dispatches_tpu.models.elec_splitter import ElectricalSplitter
+from dispatches_tpu.models.wind_power import (
+    WindPower,
+    atb2018_capacity_factors,
+    sam_windpower_capacity_factors,
+)
+from dispatches_tpu.models.solar_pv import SolarPV
+from dispatches_tpu.models.pem_electrolyzer import PEMElectrolyzer
+from dispatches_tpu.models.hydrogen_tank_simplified import SimpleHydrogenTank
+from dispatches_tpu.models.hydrogen_tank import HydrogenTank
+from dispatches_tpu.models.hydrogen_turbine import HydrogenTurbine
+from dispatches_tpu.models.translator import Translator
+from dispatches_tpu.models.mixer import Mixer
+
+__all__ = [
+    "Translator",
+    "Mixer",
+    "StateBundle",
+    "BatteryStorage",
+    "ElectricalSplitter",
+    "WindPower",
+    "atb2018_capacity_factors",
+    "sam_windpower_capacity_factors",
+    "SolarPV",
+    "PEMElectrolyzer",
+    "SimpleHydrogenTank",
+    "HydrogenTank",
+    "HydrogenTurbine",
+]

@@ -1,0 +1,75 @@
+"""Battery storage unit model.
+
+Capability counterpart of the reference's ``dispatches/unit_models/
+battery.py`` (``BatteryStorageData``): SoC evolution (:145-149),
+throughput accumulation (:151-153), degradation-linked capacity bound
+(:155-157) and nameplate power bounds (:159-165).
+
+TPU-native difference: the reference model holds ONE timestep and relies
+on ``MultiPeriodModel`` linking constraints to chain ``initial_state_of_
+charge`` across cloned blocks; here the whole horizon is a single array
+and the chaining is a shifted slice (``tshift``) — initial conditions are
+scalar vars (fix them for simulation, free them for periodic design).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispatches_tpu.core.graph import Flowsheet, UnitModel, tshift
+
+
+class BatteryStorage(UnitModel):
+    def __init__(
+        self,
+        fs: Flowsheet,
+        name: str = "battery",
+        charging_eta: float = 0.95,
+        discharging_eta: float = 0.95,
+        degradation_rate: float = 1e-4,
+    ):
+        super().__init__(fs, name)
+        dt = fs.dt_hr
+
+        # sweepable parameters (reference Params, battery.py:81-95)
+        eta_c = self.add_param("charging_eta", charging_eta)
+        eta_d = self.add_param("discharging_eta", discharging_eta)
+        deg = self.add_param("degradation_rate", degradation_rate)
+
+        # design + initial-condition vars (reference :69-107)
+        P = self.add_var("nameplate_power", shape=(), lb=0, ub=1e8, scale=1e3)
+        E = self.add_var("nameplate_energy", shape=(), lb=0, ub=1e9, scale=1e3)
+        soc0 = self.add_var("initial_state_of_charge", shape=(), lb=0, scale=1e3)
+        tp0 = self.add_var("initial_energy_throughput", shape=(), lb=0, scale=1e3)
+
+        # operating vars (reference :114-137)
+        ein = self.add_var("elec_in", lb=0, scale=1e3)
+        eout = self.add_var("elec_out", lb=0, scale=1e3)
+        soc = self.add_var("state_of_charge", lb=0, scale=1e3)
+        tput = self.add_var("energy_throughput", lb=0, scale=1e3)
+
+        # SoC evolution (reference :145-149, chained via tshift)
+        self.add_eq(
+            "state_evolution",
+            lambda v, p: v[soc]
+            - tshift(v[soc], v[soc0])
+            - dt * (p[eta_c] * v[ein] - v[eout] / p[eta_d]),
+        )
+        # throughput accumulation (reference :151-153)
+        self.add_eq(
+            "accumulate_energy_throughput",
+            lambda v, p: v[tput]
+            - tshift(v[tput], v[tp0])
+            - dt * (v[ein] + v[eout]) / 2.0,
+        )
+        # degradation-linked capacity bound (reference :155-157)
+        self.add_ineq(
+            "state_of_charge_bounds",
+            lambda v, p: v[soc] - (v[E] - p[deg] * v[tput]),
+        )
+        # nameplate power bounds (reference :159-165)
+        self.add_ineq("power_bound_in", lambda v, p: v[ein] - v[P])
+        self.add_ineq("power_bound_out", lambda v, p: v[eout] - v[P])
+
+        self.add_port("power_in", {"electricity": ein})
+        self.add_port("power_out", {"electricity": eout})

@@ -1,0 +1,18 @@
+"""Parallel execution layer: scenario sharding over the device mesh.
+
+The reference runs every scenario solve serially in one Python process
+(SURVEY.md §2.7 — no parallelism of any kind exists there).  The latent
+parallel dimensions (LMP scenarios, rolling-horizon days, stochastic bid
+scenarios) all map to ONE pattern here: a batch axis sharded over a
+``jax.sharding.Mesh``, with the IPM kernel vmapped inside and XLA
+placing the (embarrassingly-parallel) work per device.  On a v5e-8
+slice this is the "distributed communication backend" — collectives
+ride ICI implicitly via the sharding annotations.
+"""
+
+from dispatches_tpu.parallel.sharding import (
+    scenario_mesh,
+    scenario_sharded_solver,
+)
+
+__all__ = ["scenario_mesh", "scenario_sharded_solver"]

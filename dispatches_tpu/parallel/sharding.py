@@ -1,0 +1,84 @@
+"""Scenario-batch sharding of IPM solves over a device mesh."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
+
+
+def scenario_mesh(n_devices: Optional[int] = None, axis: str = "scenario") -> Mesh:
+    """1-D mesh over the available devices (the scenario/data axis)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=(axis,))
+
+
+def scenario_sharded_solver(
+    nlp,
+    mesh: Mesh,
+    batched_keys: Sequence[str] = (),
+    batched_fixed_keys: Sequence[str] = (),
+    options: Optional[IPMOptions] = None,
+    max_iter: Optional[int] = None,
+    axis: str = "scenario",
+    full_result: bool = False,
+):
+    """Build ``solve(batched) -> objs`` where ``batched`` maps param (or
+    fixed-var) names to arrays with a leading scenario axis; that axis is
+    sharded over ``mesh`` and each device runs its shard of IPM solves.
+
+    The batch size must be a multiple of the mesh size.  With
+    ``full_result=True`` the whole ``IPMResult`` pytree is returned
+    (x sharded along the scenario axis) instead of just objectives.
+    """
+    if options is not None and max_iter is not None:
+        raise ValueError("pass either options or max_iter, not both")
+    opts = options or IPMOptions(max_iter=max_iter or 100)
+    solver = make_ipm_solver(nlp, opts)
+
+    defaults = nlp.default_params()
+    in_axes_p = {k: (0 if k in batched_keys else None) for k in defaults["p"]}
+    in_axes_f = {
+        k: (0 if k in batched_fixed_keys else None) for k in defaults["fixed"]
+    }
+    vsolver = jax.vmap(solver, in_axes=({"p": in_axes_p, "fixed": in_axes_f},))
+
+    batch_sh = NamedSharding(mesh, P(axis))
+    repl_sh = NamedSharding(mesh, P())
+
+    @jax.jit
+    def _run(params):
+        res = vsolver(params)
+        return res if full_result else res.obj
+
+    def solve(batched: Dict[str, np.ndarray]):
+        p = dict(defaults["p"])
+        f = dict(defaults["fixed"])
+        for k, vals in batched.items():
+            if k not in set(batched_keys) | set(batched_fixed_keys):
+                raise KeyError(
+                    f"{k!r} was not declared in batched_keys at build time"
+                )
+            arr = jnp.asarray(vals)
+            if k in p:
+                p[k] = jax.device_put(arr, batch_sh)
+            elif k in f:
+                f[k] = jax.device_put(arr, batch_sh)
+            else:
+                raise KeyError(f"unknown param/fixed var {k!r}")
+        for k in list(p.keys()):
+            if k not in batched:
+                p[k] = jax.device_put(jnp.asarray(p[k]), repl_sh)
+        for k in list(f.keys()):
+            if k not in batched:
+                f[k] = jax.device_put(jnp.asarray(f[k]), repl_sh)
+        return _run({"p": p, "fixed": f})
+
+    return solve

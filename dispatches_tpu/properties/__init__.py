@@ -1,0 +1,32 @@
+"""Differentiable property packages (the TPU-native replacement for the
+reference's ``dispatches/properties`` + the IDAES modular property
+framework it configures; SURVEY.md §2.2).
+
+Every package here is a set of closed-form pure functions over JAX arrays
+(vectorized over the time axis, differentiable for exact KKT assembly) —
+no state blocks, no initialization ladders.
+"""
+
+from dispatches_tpu.properties.ideal_gas import (
+    IdealGasPackage,
+    h2_ideal_vap,
+    hturbine_ideal_vap,
+)
+from dispatches_tpu.properties.h2_reaction import H2CombustionReaction
+from dispatches_tpu.properties.salts import (
+    LiquidPackage,
+    SolarSalt,
+    HitecSalt,
+    ThermalOil,
+)
+
+__all__ = [
+    "IdealGasPackage",
+    "h2_ideal_vap",
+    "hturbine_ideal_vap",
+    "H2CombustionReaction",
+    "LiquidPackage",
+    "SolarSalt",
+    "HitecSalt",
+    "ThermalOil",
+]

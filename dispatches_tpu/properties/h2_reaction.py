@@ -1,0 +1,63 @@
+"""Hydrogen-combustion reaction package.
+
+TPU-native counterpart of the reference's IDAES reaction package
+``dispatches/properties/h2_reaction.py`` (stoichiometry :74-85, fixed
+molar heat of reaction −4.8366e5 J/mol at :86-88, molar-flow rate basis).
+Here the package is plain data plus a pure function mapping inlet
+component flows and a conversion to outlet component flows — consumed by
+the HydrogenTurbine composite unit's stoichiometric-reactor stage.
+
+Reaction R1:  2 H2 + O2 -> 2 H2O   (vapor phase; dh_rxn is per molar
+extent of THIS stoichiometry, i.e. -241.83 kJ per mol H2 burned)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.properties.ideal_gas import IdealGasPackage, hturbine_ideal_vap
+
+
+@dataclass(frozen=True)
+class H2CombustionReaction:
+    """Single-reaction stoichiometric package over an IdealGasPackage."""
+
+    props: IdealGasPackage = hturbine_ideal_vap
+    #: J per mol extent of R1 (2 H2 consumed); reference :86-88
+    dh_rxn: float = -4.8366e5
+    key_component: str = "hydrogen"
+    stoichiometry: Dict[str, float] = field(
+        default_factory=lambda: {
+            "hydrogen": -2.0,
+            "oxygen": -1.0,
+            "water": 2.0,
+            "nitrogen": 0.0,
+            "argon": 0.0,
+        }
+    )
+
+    def nu(self) -> np.ndarray:
+        """Stoichiometric coefficients aligned with props.components."""
+        return np.array([self.stoichiometry[c] for c in self.props.components])
+
+    def extent(self, flow_comp_in, conversion):
+        """Molar extent from fractional conversion of the key component
+        (the reference's ``conv_constraint``,
+        ``hydrogen_turbine_unit.py:115-124``): conv·F_key = -nu_key·xi."""
+        k = self.props.index(self.key_component)
+        return conversion * flow_comp_in[..., k] / (-self.stoichiometry[self.key_component])
+
+    def outlet_flows(self, flow_comp_in, conversion):
+        """Outlet component molar flows after reaction."""
+        xi = self.extent(flow_comp_in, conversion)
+        return flow_comp_in + xi[..., None] * jnp.asarray(self.nu())
+
+    def heat_of_reaction(self, flow_comp_in, conversion):
+        """Total heat released (J/s, positive = exothermic release) —
+        enters the reactor energy balance as
+        ``H_out − H_in = −dh_rxn·extent``."""
+        return -self.dh_rxn * self.extent(flow_comp_in, conversion)

@@ -1,0 +1,187 @@
+"""Ideal-gas property packages built on NIST Shomate correlations.
+
+TPU-native counterpart of the reference's modular-property config dicts
+``dispatches/properties/h2_ideal_vap.py:42-90`` (pure H2 vapor) and
+``dispatches/properties/hturbine_ideal_vap.py:42-199`` (5-component
+hydrogen/air combustion mixture), which the reference feeds to the IDAES
+``GenericParameterBlock`` (FTPx state, Ideal EoS, NIST pure-component
+correlations).  Here the same data lowers to closed-form pure functions of
+``(T, P, y)`` that are JAX-differentiable and vectorize over the leading
+time axis — the property "state block" disappears; units call these
+functions inside their residuals.
+
+Data source: NIST Chemistry WebBook Shomate coefficients (same source the
+reference cites).  Reference state: T_ref = 298.15 K, P_ref = 101325 Pa.
+
+Shomate forms (t = T/1000):
+    cp°(T)            = A + B t + C t² + D t³ + E/t²           [J/mol/K]
+    h°(T) − h°(298)   = 1000·(A t + B t²/2 + C t³/3 + D t⁴/4 − E/t + F − H)
+    s°(T)             = A ln t + B t + C t²/2 + D t³/3 − E/(2 t²) + G
+Ideal mixture with mole fractions y at pressure P:
+    h = Σ y_i h_i ;  s = Σ y_i s°_i − R Σ y_i ln y_i − R ln(P/P_ref)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+R_GAS = 8.31446261815324  # J/mol/K
+
+
+@dataclass(frozen=True)
+class IdealGasPackage:
+    """A fixed-composition-space ideal-gas mixture package."""
+
+    name: str
+    components: Tuple[str, ...]
+    mw: np.ndarray  # kg/mol, shape (C,)
+    shomate: np.ndarray  # shape (C, 8): A B C D E F G H
+    pressure_ref: float = 101325.0
+    temperature_ref: float = 298.15
+    # FTPx-style state bounds (flow mol/s, temperature K, pressure Pa):
+    # (lb, init, ub) triples mirroring the reference "state_bounds"
+    flow_bounds: Tuple[float, float, float] = (0.0, 100.0, 100000.0)
+    temperature_bounds: Tuple[float, float, float] = (273.15, 300.0, 1000.0)
+    pressure_bounds: Tuple[float, float, float] = (5e4, 1e5, 1e6)
+
+    @property
+    def n_comp(self) -> int:
+        return len(self.components)
+
+    def index(self, comp: str) -> int:
+        return self.components.index(comp)
+
+    # ---- pure-component correlations (vectorized over T) -------------
+
+    def cp_mol_comp(self, T):
+        """cp° per component, J/mol/K.  Shape (..., C)."""
+        t = jnp.asarray(T)[..., None] / 1000.0
+        A, B, C, D, E = (self.shomate[:, i] for i in range(5))
+        return A + B * t + C * t**2 + D * t**3 + E / t**2
+
+    def enth_mol_comp(self, T):
+        """Sensible enthalpy h°(T) − h°(T_ref) per component, J/mol.
+        Shape (..., C).
+
+        Computed as the Shomate polynomial differenced at T_ref, which
+        cancels the F/H integration constants exactly — every component's
+        enthalpy is zero at 298.15 K and reaction heat enters the energy
+        balances ONLY through the reaction package's dh_rxn.  (This is the
+        numerical convention the reference's turbine mixture actually
+        carries: ``hturbine_ideal_vap.py`` declares its F constants in
+        J/mol — 1000x smaller than NIST's kJ/mol — so its enthalpies are
+        sensible to within ~250 J/mol, and the explicit dh_rxn term in
+        ``h2_reaction.py:86-88`` supplies the heat of combustion.)"""
+
+        def poly(t):
+            A, B, C, D, E = (self.shomate[:, i] for i in range(5))
+            return A * t + B * t**2 / 2 + C * t**3 / 3 + D * t**4 / 4 - E / t
+
+        t = jnp.asarray(T)[..., None] / 1000.0
+        return 1000.0 * (poly(t) - poly(self.temperature_ref / 1000.0))
+
+    def entr_mol_comp(self, T):
+        """s°(T) per component at P_ref, J/mol/K.  Shape (..., C)."""
+        t = jnp.asarray(T)[..., None] / 1000.0
+        A, B, C, D, E, _F, G, _H = (self.shomate[:, i] for i in range(8))
+        return A * jnp.log(t) + B * t + C * t**2 / 2 + D * t**3 / 3 - E / (2 * t**2) + G
+
+    # ---- mixture properties ------------------------------------------
+
+    def _yfrac(self, y):
+        if y is None:
+            if self.n_comp != 1:
+                raise ValueError(f"{self.name}: mole fractions required")
+            return None
+        return jnp.asarray(y)
+
+    def cp_mol(self, T, y=None):
+        cps = self.cp_mol_comp(T)
+        y = self._yfrac(y)
+        return cps[..., 0] if y is None else jnp.sum(y * cps, axis=-1)
+
+    def enth_mol(self, T, y=None):
+        hs = self.enth_mol_comp(T)
+        y = self._yfrac(y)
+        return hs[..., 0] if y is None else jnp.sum(y * hs, axis=-1)
+
+    def entr_mol(self, T, P, y=None):
+        ss = self.entr_mol_comp(T)
+        P = jnp.asarray(P)
+        press = -R_GAS * jnp.log(P / self.pressure_ref)
+        y = self._yfrac(y)
+        if y is None:
+            return ss[..., 0] + press
+        # smooth xlogy: y log y -> 0 as y -> 0 (combustion can consume a
+        # component entirely; keep the gradient finite there)
+        eps = 1e-30
+        mixing = -R_GAS * jnp.sum(y * jnp.log(jnp.maximum(y, eps)), axis=-1)
+        return jnp.sum(y * ss, axis=-1) + mixing + press
+
+    def mw_mix(self, y=None):
+        y = self._yfrac(y)
+        return self.mw[0] if y is None else jnp.sum(y * self.mw, axis=-1)
+
+    def dens_mol(self, T, P):
+        """Ideal-gas molar density, mol/m^3."""
+        return jnp.asarray(P) / (R_GAS * jnp.asarray(T))
+
+
+# ---------------------------------------------------------------------------
+# Package instances (NIST WebBook data, as consumed by the reference configs)
+# ---------------------------------------------------------------------------
+
+# Shomate rows: A, B, C, D, E, F, G, H
+_SHOMATE: Dict[str, list] = {
+    # H2, valid 298-1000 K
+    "hydrogen": [33.066178, -11.363417, 11.432816, -2.772874, -0.158558,
+                 -9.980797, 172.707974, 0.0],
+    # N2, 100-500 K range fit used by the reference
+    "nitrogen": [19.50583, 19.88705, -8.598535, 1.369784, 0.527601,
+                 -4.935202, 212.39000, 0.0],
+    # O2, 100-700 K
+    "oxygen": [31.32234, -20.23531, 57.86644, -36.50624, -0.007374,
+               -8.903471, 246.7945, 0.0],
+    # H2O vapor, 500-1700 K
+    "water": [30.092, 6.832514, 6.793435, -2.53448, 0.082139,
+              -250.881, 223.3967, 0.0],
+    # Ar (monoatomic, cp = 20.786)
+    "argon": [20.786, 0.000000282, -0.000000146, 0.00000001092, -0.0000000366,
+              -6.19735, 179.999, 0.0],
+}
+
+_MW: Dict[str, float] = {
+    "hydrogen": 2.016e-3,
+    "nitrogen": 28.0134e-3,
+    "oxygen": 31.9988e-3,
+    "water": 18.0153e-3,
+    "argon": 39.948e-3,
+}
+
+
+def _mk(name: str, comps: Tuple[str, ...], **kw) -> IdealGasPackage:
+    return IdealGasPackage(
+        name=name,
+        components=comps,
+        mw=np.array([_MW[c] for c in comps]),
+        shomate=np.array([_SHOMATE[c] for c in comps]),
+        **kw,
+    )
+
+
+#: Pure H2 vapor — reference ``h2_ideal_vap.py`` (state bounds ibid. :87-90)
+h2_ideal_vap = _mk("h2_ideal_vap", ("hydrogen",))
+
+#: 5-component H2-combustion mixture — reference ``hturbine_ideal_vap.py``
+#: (state bounds ibid.: flow 0-10000 mol/s, T 273.15-2000 K, P 5e4-1e8 Pa)
+hturbine_ideal_vap = _mk(
+    "hturbine_ideal_vap",
+    ("hydrogen", "nitrogen", "oxygen", "water", "argon"),
+    flow_bounds=(0.0, 100.0, 10000.0),
+    temperature_bounds=(273.15, 300.0, 2000.0),
+    pressure_bounds=(5e4, 1e5, 1e8),
+)

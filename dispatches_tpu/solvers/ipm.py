@@ -63,10 +63,20 @@ class IPMOptions(NamedTuple):
     obj_scale: float = 1.0
     ls_armijo: float = 1e-6
     kappa_sigma: float = 1e10  # dual safeguard clamp
+    # IPOPT-style acceptable termination: stop after `acceptable_iter`
+    # consecutive iterations at `acceptable_tol` (rank-deficient / free-
+    # direction systems plateau above the strict tol)
+    acceptable_tol: float = 1e-5
+    acceptable_iter: int = 10
+    autoscale: bool = True  # gradient-based constraint/objective scaling
 
 
 class IPMResult(NamedTuple):
-    x: jnp.ndarray  # primal solution (decision variables only, no slacks)
+    # primal solution in the SCALED decision space (x_phys = x * var_scale;
+    # use nlp.unravel(res.x) for physical values).  NOTE solve()'s x0
+    # argument is PHYSICAL — do not feed res.x back as x0; warm-start via
+    # nlp.unravel + a physical vector, or pass x0=None.
+    x: jnp.ndarray
     slacks: jnp.ndarray
     lam: jnp.ndarray  # equality+inequality multipliers
     z_l: jnp.ndarray
@@ -85,10 +95,15 @@ class _State(NamedTuple):
     mu: jnp.ndarray
     it: jnp.ndarray
     done: jnp.ndarray
+    acc: jnp.ndarray  # consecutive iterations at acceptable_tol
+    err_prev: jnp.ndarray  # KKT error of previous iterate
+    stall: jnp.ndarray  # consecutive iterations without progress
 
 
-def _make_funcs(nlp):
-    """Wrap a CompiledNLP into (f, C) over the slack-augmented vector y."""
+def _make_funcs(nlp, r_eq=None, r_in=None):
+    """Wrap a CompiledNLP into (f, C) over the slack-augmented vector y.
+    ``r_eq``/``r_in`` are static row-scaling vectors applied to the
+    constraint residuals (slacks live in the scaled inequality units)."""
     n_x, m_eq, m_in = nlp.n, nlp.m_eq, nlp.m_ineq
 
     def fobj(y, p):
@@ -98,9 +113,11 @@ def _make_funcs(nlp):
         x = y[:n_x]
         parts = []
         if m_eq:
-            parts.append(nlp.eq(x, p))
+            e = nlp.eq(x, p)
+            parts.append(e if r_eq is None else e * r_eq)
         if m_in:
-            parts.append(nlp.ineq(x, p) + y[n_x:])
+            i = nlp.ineq(x, p)
+            parts.append((i if r_in is None else i * r_in) + y[n_x:])
         if not parts:
             return jnp.zeros((0,), dtype=y.dtype)
         return jnp.concatenate(parts)
@@ -117,6 +134,30 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
     n = n_x + m_in
     m = m_eq + m_in
 
+    # Gradient-based automatic row scaling (IPOPT's default
+    # nlp_scaling_method): normalize each constraint so its largest
+    # Jacobian entry at x0 is <= 1, and scale the objective so its
+    # gradient is <= 100.  Computed once at build with default params —
+    # static across the vmapped batch.
+    r_eq = np.ones(m_eq)
+    r_in = np.ones(m_in)
+    obj_auto = 1.0
+    if getattr(opts, "autoscale", True) and n_x:
+        p0 = nlp.default_params()
+        x0_ = jnp.asarray(nlp.x0)
+        if m_eq:
+            Je = np.asarray(jax.jacfwd(lambda x: nlp.eq(x, p0))(x0_))
+            rows = np.max(np.abs(Je), axis=1)
+            r_eq = 1.0 / np.maximum(1.0, np.where(np.isfinite(rows), rows, 1.0))
+        if m_in:
+            Ji = np.asarray(jax.jacfwd(lambda x: nlp.ineq(x, p0))(x0_))
+            rows = np.max(np.abs(Ji), axis=1)
+            r_in = 1.0 / np.maximum(1.0, np.where(np.isfinite(rows), rows, 1.0))
+        g0 = np.asarray(jax.grad(lambda x: nlp.objective(x, p0))(x0_))
+        gmax = float(np.max(np.abs(g0))) if g0.size else 0.0
+        if np.isfinite(gmax) and gmax > 100.0:
+            obj_auto = 100.0 / gmax
+
     L = np.concatenate([nlp.lb, np.zeros(m_in)])
     U = np.concatenate([nlp.ub, np.full(m_in, math.inf)])
     has_lb = np.isfinite(L)
@@ -128,10 +169,10 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
     L_s = np.where(has_lb, L, 0.0)  # safe values for arithmetic
     U_s = np.where(has_ub, U, 0.0)
 
-    fobj_raw, cons = _make_funcs(nlp)
+    fobj_raw, cons = _make_funcs(nlp, jnp.asarray(r_eq), jnp.asarray(r_in))
 
     def fobj(y, p):
-        return fobj_raw(y, p) * opts.obj_scale
+        return fobj_raw(y, p) * (opts.obj_scale * obj_auto)
 
     grad_f = jax.grad(fobj)
     jac_c = jax.jacfwd(cons)
@@ -143,6 +184,18 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
     hess_l = jax.hessian(lagrangian, argnums=0)
 
     eps = 1e-12
+
+    def _lsq_multipliers(g, J, dtype):
+        """Least-squares multiplier estimate: (J J^T + d I) lam = -J g,
+        with a zero fallback on non-finite results.  Used for both the
+        initial lam and the stall-refresh."""
+        from jax.scipy.linalg import cho_solve
+
+        A = J @ J.T + 1e-8 * jnp.eye(m, dtype=dtype)
+        lam_ls = cho_solve((jnp.linalg.cholesky(A), True), -(J @ g))
+        return jnp.where(
+            jnp.all(jnp.isfinite(lam_ls)), lam_ls, jnp.zeros_like(lam_ls)
+        )
 
     def _dists(y):
         dL = jnp.where(has_lb, y - L_s, 1.0)
@@ -156,10 +209,13 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
         )
         return mu * jnp.sum(terms)
 
-    def _kkt_error(y, p, lam, z_l, z_u, mu):
-        g = grad_f(y, p)
-        J = jac_c(y, p)
-        c = cons(y, p)
+    def _kkt_error(y, p, lam, z_l, z_u, mu, gJc=None):
+        """Scaled KKT error; pass precomputed ``(g, J, c)`` at ``y`` to
+        avoid re-deriving the Jacobian (one jacfwd serves every mu/lam/z
+        combination at the same primal point)."""
+        g, J, c = gJc if gJc is not None else (
+            grad_f(y, p), jac_c(y, p), cons(y, p)
+        )
         dL, dU = _dists(y)
         r_d = g + (J.T @ lam if m else 0.0) - z_l + z_u
         comp_l = jnp.where(has_lb, dL * z_l - mu, 0.0)
@@ -198,10 +254,13 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
             return jnp.linalg.cholesky(H)
 
         # inertia-correction ladder: retry with 100x regularization until
-        # the factorization succeeds (NaN-free)
+        # the factorization succeeds (NaN-free).  12 tries reach delta_w
+        # ~1e16, enough to dominate any curvature representable in f64 —
+        # the ladder must END in a usable factor, else the iteration
+        # freezes on NaN directions.
         def esc_cond(carry):
             dw, L_H, tries = carry
-            return (~jnp.all(jnp.isfinite(L_H))) & (tries < 6)
+            return (~jnp.all(jnp.isfinite(L_H))) & (tries < 12)
 
         def esc_body(carry):
             dw, _, tries = carry
@@ -256,10 +315,20 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
             return jnp.minimum(1.0, jnp.min(shrink, initial=jnp.inf))
 
         alpha_p_max = jnp.minimum(_max_alpha(dy, dL, has_lb), _max_alpha(-dy, dU, has_ub))
-        alpha_d_max = jnp.minimum(
-            _max_alpha(dz_l, jnp.where(has_lb, z_l, 1.0), jnp.asarray(has_lb)),
-            _max_alpha(dz_u, jnp.where(has_ub, z_u, 1.0), jnp.asarray(has_ub)),
-        )
+
+        # Per-element dual steps: each bound multiplier only needs to stay
+        # positive, so unlike the primal (whose step must be a single
+        # scalar to keep the search direction), z_i can each take their
+        # own fraction-to-boundary length.  A single global alpha_d gets
+        # throttled to ~0 by near-floor multipliers of far-away bounds and
+        # stalls convergence on problems with free/underdetermined vars.
+        def _alpha_vec(z, dz, active):
+            neg = active & (dz < 0)
+            a = jnp.where(neg, -tau * z / jnp.minimum(dz, -eps), 1.0)
+            return jnp.minimum(1.0, a)
+
+        alpha_zl = _alpha_vec(z_l, dz_l, jnp.asarray(has_lb))
+        alpha_zu = _alpha_vec(z_u, dz_u, jnp.asarray(has_ub))
 
         # l1 merit with barrier; parallel backtracking fan
         nu = 10.0 * (1.0 + jnp.max(jnp.abs(lam), initial=0.0))
@@ -277,18 +346,36 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
         )
         alphas = alpha_p_max * (0.6 ** jnp.arange(opts.n_linesearch, dtype=y.dtype))
         phis = jax.vmap(lambda a: merit(y + a * dy))(alphas)
-        ok = (phis <= phi0 + opts.ls_armijo * alphas * jnp.minimum(dphi, 0.0)) & jnp.isfinite(
-            phis
-        )
+        # machine-precision slack: near a solution dy ~ 0 and phi(y+a dy)
+        # equals phi0 up to rounding; without the slack every candidate is
+        # rejected and the dual step collapses to alphas[-1]
+        slack = 1e-13 * (1.0 + jnp.abs(phi0))
+        ok = (
+            phis <= phi0 + opts.ls_armijo * alphas * jnp.minimum(dphi, 0.0) + slack
+        ) & jnp.isfinite(phis)
         # pick the largest admissible alpha; fall back to the smallest trial
         idx = jnp.argmax(ok)  # first True along the decreasing-alpha fan
         any_ok = jnp.any(ok)
         alpha = jnp.where(any_ok, alphas[idx], alphas[-1])
 
+        z_l_new = z_l + alpha_zl * dz_l
+        z_u_new = z_u + alpha_zu * dz_u
+
+        # KKT-error-reduction acceptance: the l1 merit is blind to dual
+        # infeasibility, so near-solution steps whose only job is fixing
+        # the multipliers get rejected over rounding-level primal noise
+        # (e.g. the delta_c-regularization component).  If the full step
+        # strictly reduces the scaled KKT error, take it over the merit
+        # choice — the analog of IPOPT's optimality-error acceptance.
+        err_cur = _kkt_error(y, p, lam, z_l, z_u, mu, gJc=(g, J, c))
+        y_full = y + alpha_p_max * dy
+        lam_full = lam + alpha_p_max * dlam
+        err_full = _kkt_error(y_full, p, lam_full, z_l_new, z_u_new, mu)
+        take_full = jnp.isfinite(err_full) & (err_full <= 0.9 * err_cur)
+        alpha = jnp.where(take_full, alpha_p_max, alpha)
+
         y_new = y + alpha * dy
         lam_new = lam + alpha * dlam
-        z_l_new = z_l + alpha_d_max * dz_l
-        z_u_new = z_u + alpha_d_max * dz_u
 
         # IPOPT kappa_sigma safeguard: keep z compatible with mu/dist
         dLn, dUn = _dists(y_new)
@@ -323,8 +410,12 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
         z_l_new = jnp.where(bad, z_l, z_l_new)
         z_u_new = jnp.where(bad, z_u, z_u_new)
 
+        # one gradient/Jacobian/constraint evaluation at y_new serves the
+        # barrier test, the stall check, and the termination check below
+        gJc_new = (grad_f(y_new, p), jac_c(y_new, p), cons(y_new, p))
+
         # barrier update (monotone)
-        err_mu = _kkt_error(y_new, p, lam_new, z_l_new, z_u_new, mu)
+        err_mu = _kkt_error(y_new, p, lam_new, z_l_new, z_u_new, mu, gJc=gJc_new)
         shrink = err_mu <= opts.kappa_eps * mu
         mu_new = jnp.where(
             shrink,
@@ -332,14 +423,53 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
             mu,
         )
 
-        err0 = _kkt_error(y_new, p, lam_new, z_l_new, z_u_new, 0.0)
-        done = err0 <= opts.tol
+        # stall detection + multiplier refresh: a cold start on a stiff
+        # square system can walk lam far off while the primal homes in;
+        # the Newton direction then cannot recover (the role of IPOPT's
+        # restoration phase).  On 8 stagnant iterations, re-estimate lam
+        # by least squares at the current point and reset z to mu/dist.
+        err_chk = _kkt_error(
+            y_new, p, lam_new, z_l_new, z_u_new, mu_new, gJc=gJc_new
+        )
+        improved = err_chk < 0.9999 * state.err_prev
+        stall = jnp.where(improved, 0, state.stall + 1)
+        do_reset = stall >= 8
 
-        return _State(y_new, lam_new, z_l_new, z_u_new, mu_new, state.it + 1, done)
+        if m:
+            def _refresh(_):
+                g2, J2, _c2 = gJc_new
+                return _lsq_multipliers(g2, J2, y.dtype)
+
+            lam_new = lax.cond(do_reset, _refresh, lambda _: lam_new, None)
+        dLr, dUr = _dists(y_new)
+        z_l_new = jnp.where(
+            do_reset & has_lb, mu_new / jnp.maximum(dLr, eps), z_l_new
+        )
+        z_u_new = jnp.where(
+            do_reset & has_ub, mu_new / jnp.maximum(dUr, eps), z_u_new
+        )
+        stall = jnp.where(do_reset, 0, stall)
+
+        err0 = _kkt_error(y_new, p, lam_new, z_l_new, z_u_new, 0.0, gJc=gJc_new)
+        acc = jnp.where(err0 <= opts.acceptable_tol, state.acc + 1, 0)
+        done = (err0 <= opts.tol) | (acc >= opts.acceptable_iter)
+
+        return _State(
+            y_new, lam_new, z_l_new, z_u_new, mu_new, state.it + 1, done, acc,
+            err_chk, stall,
+        )
 
     def solve(params, x0=None, lam0=None):
         dtype = jnp.zeros(0).dtype  # x64 if enabled
-        x_init = jnp.asarray(nlp.x0 if x0 is None else x0, dtype=dtype)
+        # user-facing x0 is PHYSICAL (like add_var init / set_init / fix);
+        # internally the decision vector is scaled by nlp.var_scale, and
+        # IPMResult.x is in that scaled space (nlp.unravel converts back)
+        if x0 is None:
+            x_init = jnp.asarray(nlp.x0, dtype=dtype)
+        else:
+            x_init = jnp.asarray(x0, dtype=dtype) / jnp.asarray(
+                nlp.var_scale, dtype=dtype
+            )
 
         # push the primal point strictly inside its bounds (IPOPT bound_push)
         def _push(v, lo, hi, has_lo, has_hi):
@@ -355,7 +485,9 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
         x_in = _push(x_init, L_s[:n_x], U_s[:n_x], has_lb[:n_x], has_ub[:n_x])
         # slacks: s = max(-g(x), push)
         if m_in:
-            s0 = jnp.maximum(-nlp.ineq(x_in, params), opts.bound_push)
+            s0 = jnp.maximum(
+                -nlp.ineq(x_in, params) * jnp.asarray(r_in), opts.bound_push
+            )
         else:
             s0 = jnp.zeros((0,), dtype=dtype)
         y0 = jnp.concatenate([x_in, s0])
@@ -366,21 +498,17 @@ def make_ipm_solver(nlp, options: Optional[IPMOptions] = None):
         z_u0 = jnp.where(has_ub, mu0 / jnp.maximum(dU0, eps), 0.0)
 
         if lam0 is None and m:
-            # least-squares multiplier init: (J J^T + d) lam = -J g
-            g0 = grad_f(y0, params)
-            J0 = jac_c(y0, params)
-            from jax.scipy.linalg import cho_solve
-
-            A = J0 @ J0.T + 1e-8 * jnp.eye(m, dtype=dtype)
-            lam_init = cho_solve((jnp.linalg.cholesky(A), True), -(J0 @ g0))
-            lam_init = jnp.where(jnp.all(jnp.isfinite(lam_init)), lam_init, jnp.zeros(m))
+            lam_init = _lsq_multipliers(
+                grad_f(y0, params), jac_c(y0, params), dtype
+            )
         elif lam0 is None:
             lam_init = jnp.zeros((0,), dtype=dtype)
         else:
             lam_init = jnp.asarray(lam0, dtype=dtype)
 
         state0 = _State(
-            y0, lam_init, z_l0, z_u0, mu0, jnp.asarray(0), jnp.asarray(False)
+            y0, lam_init, z_l0, z_u0, mu0, jnp.asarray(0), jnp.asarray(False),
+            jnp.asarray(0), jnp.asarray(jnp.inf, dtype=dtype), jnp.asarray(0),
         )
 
         def cond(st):
