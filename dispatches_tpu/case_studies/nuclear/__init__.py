@@ -1,0 +1,8 @@
+"""Nuclear case study (reference ``case_studies/nuclear_case``):
+nuclear plant + PEM + H2 tank + H2 turbine co-production.
+"""
+
+from dispatches_tpu.case_studies.nuclear.flowsheet import (
+    build_ne_flowsheet,
+    fix_dof_and_initialize,
+)

@@ -7,3 +7,9 @@ from dispatches_tpu.case_studies.renewables.flowsheet import create_model
 from dispatches_tpu.case_studies.renewables.wind_battery_lmp import (
     wind_battery_optimize,
 )
+from dispatches_tpu.case_studies.renewables.wind_battery_pem_lmp import (
+    wind_battery_pem_optimize,
+)
+from dispatches_tpu.case_studies.renewables.wind_battery_pem_tank_turbine_lmp import (
+    wind_battery_pem_tank_turb_optimize,
+)

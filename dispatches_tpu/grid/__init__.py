@@ -1,0 +1,29 @@
+"""Grid-integration layer: the TPU-native rebuild of the consumed
+``idaes.apps.grid_integration`` API (SURVEY.md §2.8) — generator model
+data, price forecasters, the bidding/tracking protocol, Bidder /
+SelfScheduler / Tracker, and the double-loop coordinator.
+
+The reference's pattern re-solves a freshly-cloned Pyomo model through a
+solver subprocess at every rolling-horizon step; here the operation
+model compiles ONCE per horizon and every re-solve is a jitted IPM call
+with new params (capacity factors, initial conditions, prices), and the
+bidder's price scenarios batch under vmap.
+"""
+
+from dispatches_tpu.grid.model_data import (
+    RenewableGeneratorModelData,
+    ThermalGeneratorModelData,
+)
+from dispatches_tpu.grid.forecaster import Backcaster, PerfectForecaster
+from dispatches_tpu.grid.tracker import Tracker
+from dispatches_tpu.grid.bidder import Bidder, SelfScheduler
+
+__all__ = [
+    "RenewableGeneratorModelData",
+    "ThermalGeneratorModelData",
+    "Backcaster",
+    "PerfectForecaster",
+    "Tracker",
+    "Bidder",
+    "SelfScheduler",
+]

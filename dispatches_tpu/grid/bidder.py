@@ -1,0 +1,211 @@
+"""Bidder / SelfScheduler: scenario-based bid optimization.
+
+Capability counterpart of ``idaes.apps.grid_integration.bidder`` as
+consumed by the reference (``run_double_loop.py:241-258``,
+``test_multiperiod_wind_battery_doubleloop.py:152-252``): optimize the
+operation model against forecast price scenarios and emit either a
+self-schedule (per-hour p_max energies) or thermal-style bid curves
+(per-hour (power, cost) pairs).
+
+TPU-native difference: the reference builds one stacked Pyomo model with
+``fs`` indexed by scenario and hands it to a MILP solver; here the
+scenario axis is a ``vmap`` batch over the SAME compiled kernel with the
+price signal as the batched parameter (SURVEY.md §2.7 scenario
+parallelism).  Scenario results are combined by probability weight —
+the stochastic program's first stage; a hard non-anticipativity
+coupling across the batch is planned via a scenario-axis flowsheet.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+
+class _BidderBase:
+    def __init__(
+        self,
+        bidding_model_object,
+        day_ahead_horizon: int,
+        real_time_horizon: int,
+        n_scenario: int,
+        solver=None,
+        forecaster=None,
+        max_iter: int = 300,
+    ):
+        self.bidding_model_object = bidding_model_object
+        self.day_ahead_horizon = int(day_ahead_horizon)
+        self.real_time_horizon = int(real_time_horizon)
+        self.n_scenario = int(n_scenario)
+        self.forecaster = forecaster
+        self.generator = bidding_model_object.model_data.gen_name
+        self.bids_result_list = []
+        self._max_iter = max_iter
+
+        self.day_ahead_model = self._build(self.day_ahead_horizon)
+        self.real_time_model = self._build(self.real_time_horizon)
+
+    def _build(self, horizon: int):
+        blk = SimpleNamespace()
+        self.bidding_model_object.populate_model(blk, horizon)
+        fs = blk.m.fs
+        fs.add_param("energy_price", np.zeros(horizon))
+
+        def objective(v, p):
+            revenue = jnp.sum(
+                p["energy_price"] * blk.power_output_expr(v, p)
+            )
+            cost = jnp.sum(blk.total_cost_expr(v, p))
+            return revenue - cost
+
+        blk.nlp = fs.compile(objective=objective, sense="max")
+        solver = make_ipm_solver(blk.nlp, IPMOptions(max_iter=self._max_iter))
+        blk.vsolve = jax.jit(
+            jax.vmap(
+                solver,
+                in_axes=(
+                    {
+                        "p": {
+                            k: (0 if k == "energy_price" else None)
+                            for k in blk.nlp.default_params()["p"]
+                        },
+                        "fixed": None,
+                    },
+                ),
+            )
+        )
+        return blk
+
+    def _scenario_solve(self, blk, prices: np.ndarray) -> np.ndarray:
+        """Solve all price scenarios batched; returns per-scenario power
+        profiles (n_scenario, horizon) in MW."""
+        params = blk.nlp.default_params()
+        batched = {
+            "p": {**params["p"], "energy_price": jnp.asarray(prices)},
+            "fixed": params["fixed"],
+        }
+        res = blk.vsolve(batched)
+        sols = [blk.nlp.unravel(np.asarray(res.x)[s]) for s in range(len(prices))]
+        return np.stack(
+            [np.asarray(blk.power_output_values(s)) for s in sols]
+        ), res
+
+    def _forecast(self, date, hour, horizon):
+        bus = self.bidding_model_object.model_data.bus
+        return np.asarray(
+            self.forecaster.forecast_day_ahead_prices(
+                date, hour, bus, horizon, self.n_scenario
+            )
+        )
+
+    def update_day_ahead_model(self, **profiles):
+        self.bidding_model_object.update_model(self.day_ahead_model, **profiles)
+
+    def update_real_time_model(self, **profiles):
+        self.bidding_model_object.update_model(self.real_time_model, **profiles)
+
+    def write_results(self, path):
+        import pandas as pd
+
+        if self.bids_result_list:
+            pd.concat(self.bids_result_list).to_csv(path, index=False)
+
+    def record_bids(self, bids, date, hour):
+        import pandas as pd
+
+        rows = [
+            {"Generator": self.generator, "Date": date, "Hour": hour,
+             "HorizonHour": t, **info}
+            for t, gen_bids in bids.items()
+            for info in [
+                {k: v for k, v in gen_bids[self.generator].items()
+                 if not isinstance(v, list)}
+            ]
+        ]
+        self.bids_result_list.append(pd.DataFrame(rows))
+
+
+class SelfScheduler(_BidderBase):
+    """Self-scheduling participant: bids are per-hour scheduled energies
+    (reference test :152-177: ``bids[t][gen]['p_max']``)."""
+
+    def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
+        prices = self._forecast(date, hour, self.day_ahead_horizon)  # $/MWh
+        powers, _ = self._scenario_solve(self.day_ahead_model, prices)
+        schedule = powers.mean(axis=0)  # probability-weighted first stage
+        md = self.bidding_model_object.model_data
+        bids = {
+            t: {
+                self.generator: {
+                    "p_min": md.p_min,
+                    "p_max": float(schedule[t]),
+                }
+            }
+            for t in range(self.day_ahead_horizon)
+        }
+        return bids
+
+    def compute_real_time_bids(self, date, hour, realized_day_ahead_prices=None,
+                               realized_day_ahead_dispatches=None) -> Dict:
+        bus = self.bidding_model_object.model_data.bus
+        prices = np.asarray(
+            self.forecaster.forecast_real_time_prices(
+                date, hour, bus, self.real_time_horizon, self.n_scenario
+            )
+        )
+        powers, _ = self._scenario_solve(self.real_time_model, prices)
+        schedule = powers.mean(axis=0)
+        md = self.bidding_model_object.model_data
+        return {
+            t: {self.generator: {"p_min": md.p_min, "p_max": float(schedule[t])}}
+            for t in range(self.real_time_horizon)
+        }
+
+
+class Bidder(_BidderBase):
+    """Thermal-style bidder: per-hour convex bid curves
+    (reference test :218-252: ``bids[t][gen]['p_cost']`` pairs)."""
+
+    def _curves(self, prices: np.ndarray, powers: np.ndarray, horizon: int):
+        md = self.bidding_model_object.model_data
+        mean_price = prices.mean(axis=0)
+        sched = powers.mean(axis=0)
+        bids = {}
+        for t in range(horizon):
+            price = float(mean_price[t])
+            if sched[t] > 1e-6 and price > 0:
+                curve = [(md.p_min, 0.0), (md.p_max, price * md.p_max)]
+            else:
+                curve = [(md.p_min, 0.0), (md.p_max, 0.0)]
+            bids[t] = {
+                self.generator: {
+                    "p_min": md.p_min,
+                    "p_max": md.p_max,
+                    "p_cost": curve,
+                    "startup_capacity": getattr(md, "startup_capacity", md.p_max),
+                    "shutdown_capacity": getattr(md, "shutdown_capacity", md.p_max),
+                }
+            }
+        return bids
+
+    def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
+        prices = self._forecast(date, hour, self.day_ahead_horizon)
+        powers, _ = self._scenario_solve(self.day_ahead_model, prices)
+        return self._curves(prices, powers, self.day_ahead_horizon)
+
+    def compute_real_time_bids(self, date, hour, realized_day_ahead_prices=None,
+                               realized_day_ahead_dispatches=None) -> Dict:
+        bus = self.bidding_model_object.model_data.bus
+        prices = np.asarray(
+            self.forecaster.forecast_real_time_prices(
+                date, hour, bus, self.real_time_horizon, self.n_scenario
+            )
+        )
+        powers, _ = self._scenario_solve(self.real_time_model, prices)
+        return self._curves(prices, powers, self.real_time_horizon)

@@ -164,13 +164,15 @@ class HydrogenTurbine(UnitModel):
 
     # ------------------------------------------------------------------
 
-    def initialize(self) -> None:
+    def initialize(self, flow_mol_comp=None, temperature=None, pressure=None) -> None:
         """Host-side stagewise warm start (the TPU-native counterpart of
         the reference's sequential ``initialize_build`` → ``propagate_state``
         chain, ``hydrogen_turbine_unit.py:141-154``): solve each stage's
         state with scalar bisections on the closed-form Shomate curves and
         write the results as variable inits.  Reads the currently-fixed
-        inlet state and stage parameters from the flowsheet."""
+        inlet state and stage parameters from the flowsheet unless a
+        nominal inlet is passed explicitly (for flowsheets where the
+        turbine feed is a free stream)."""
         import numpy as np
 
         fs, props, rxn = self.fs, self.props, self.reaction
@@ -184,9 +186,28 @@ class HydrogenTurbine(UnitModel):
                 return np.asarray(s.init, dtype=float)
             return np.asarray(default, dtype=float)
 
-        fc = np.atleast_2d(fixed("inlet.flow_mol_comp"))
-        T_in = np.atleast_1d(fixed("inlet.temperature"))
-        P_in = np.atleast_1d(fixed("inlet.pressure"))
+        fc = (
+            np.atleast_2d(fixed("inlet.flow_mol_comp"))
+            if flow_mol_comp is None
+            else np.atleast_2d(np.asarray(flow_mol_comp, dtype=float))
+        )
+        T_in = (
+            np.atleast_1d(fixed("inlet.temperature"))
+            if temperature is None
+            else np.atleast_1d(np.asarray(temperature, dtype=float))
+        )
+        P_in = (
+            np.atleast_1d(fixed("inlet.pressure"))
+            if pressure is None
+            else np.atleast_1d(np.asarray(pressure, dtype=float))
+        )
+        if flow_mol_comp is not None:
+            fs.set_init(self.v("inlet.flow_mol_comp"), fc)
+            fs.set_init(self.v("inlet.flow_mol"), fc.sum(-1))
+        if temperature is not None:
+            fs.set_init(self.v("inlet.temperature"), T_in)
+        if pressure is not None:
+            fs.set_init(self.v("inlet.pressure"), P_in)
 
         def bisect(f, lo, hi, iters=80):
             lo = np.full_like(np.asarray(f(lo) * 0.0) + lo, lo, dtype=float)

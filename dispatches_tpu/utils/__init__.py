@@ -1,0 +1,29 @@
+"""Utility/economics layer (reference ``dispatches/util``): cash-flow
+metrics (TEAL integration counterpart) and ARMA synthetic-history
+sampling (RAVEN integration counterpart).
+"""
+
+from dispatches_tpu.utils.cashflow import (
+    CashFlowSettings,
+    Capex,
+    Recurring,
+    npv,
+    irr,
+    profitability_index,
+    macrs_amortization,
+    build_cashflows,
+)
+from dispatches_tpu.utils.synhist import ARMAModel, generate_syn_realizations
+
+__all__ = [
+    "CashFlowSettings",
+    "Capex",
+    "Recurring",
+    "npv",
+    "irr",
+    "profitability_index",
+    "macrs_amortization",
+    "build_cashflows",
+    "ARMAModel",
+    "generate_syn_realizations",
+]

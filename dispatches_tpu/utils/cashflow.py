@@ -1,0 +1,123 @@
+"""Cash-flow economics: NPV / IRR / PI with MACRS amortization.
+
+Capability counterpart of the reference's TEAL integration
+(``dispatches/util/teal_integration.py``: builds TEAL ``CashFlows`` from
+Pyomo model values, applies MACRS amortization, and runs
+``RunCashFlow.run`` to produce NPV/IRR/PI expressions, :49-259).  Here
+the cash-flow algebra is plain differentiable JAX over a yearly cash
+array — usable directly inside an optimization objective, which the
+reference needed the TEAL/pyomoVar bridge for.
+
+Cash-flow model (TEAL conventions):
+    capex at year 0 (optionally amortized via MACRS depreciation with a
+    tax shield), recurring yearly revenues/costs over the project life,
+    discounted at WACC/discount rate; IRR via damped Newton on the NPV
+    polynomial.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: IRS MACRS half-year-convention depreciation schedules (fractions)
+MACRS = {
+    3: [0.3333, 0.4445, 0.1481, 0.0741],
+    5: [0.20, 0.32, 0.192, 0.1152, 0.1152, 0.0576],
+    7: [0.1429, 0.2449, 0.1749, 0.1249, 0.0893, 0.0892, 0.0893, 0.0446],
+    10: [0.10, 0.18, 0.144, 0.1152, 0.0922, 0.0737, 0.0655, 0.0655,
+         0.0656, 0.0655, 0.0328],
+    15: [0.05, 0.095, 0.0855, 0.077, 0.0693, 0.0623, 0.059, 0.059, 0.0591,
+         0.059, 0.0591, 0.059, 0.0591, 0.059, 0.0591, 0.0295],
+    20: [0.0375, 0.07219, 0.06677, 0.06177, 0.05713, 0.05285, 0.04888,
+         0.04522, 0.04462, 0.04461, 0.04462, 0.04461, 0.04462, 0.04461,
+         0.04462, 0.04461, 0.04462, 0.04461, 0.04462, 0.04461, 0.02231],
+}
+
+
+@dataclass
+class CashFlowSettings:
+    """Global economics settings (reference getSettings/TEAL settings)."""
+
+    discount_rate: float = 0.08
+    tax_rate: float = 0.0
+    inflation: float = 0.0
+    project_life: int = 30
+
+
+@dataclass
+class Capex:
+    name: str
+    amount: float  # $ at year 0 (positive cost)
+    amortize_years: Optional[int] = None  # MACRS schedule key
+
+
+@dataclass
+class Recurring:
+    name: str
+    yearly_amount: float  # $ per year; positive = revenue, negative = cost
+
+
+def macrs_amortization(amount, years: int):
+    """Yearly depreciation amounts for a MACRS class (reference
+    ``teal_integration.py`` MACRS handling)."""
+    sched = jnp.asarray(MACRS[years])
+    return jnp.asarray(amount) * sched
+
+
+def build_cashflows(
+    capex: Sequence[Capex],
+    recurring: Sequence[Recurring],
+    settings: CashFlowSettings,
+):
+    """Yearly net cash array (year 0 .. project_life)."""
+    n = settings.project_life
+    cash = jnp.zeros(n + 1)
+    for cf in capex:
+        cash = cash.at[0].add(-cf.amount)
+        if cf.amortize_years:
+            dep = macrs_amortization(cf.amount, cf.amortize_years)
+            # tax shield of depreciation
+            shield = settings.tax_rate * dep
+            upto = min(len(np.asarray(dep)), n)
+            cash = cash.at[1: upto + 1].add(shield[:upto])
+    for r in recurring:
+        net = r.yearly_amount * (1.0 - settings.tax_rate) if r.yearly_amount > 0 \
+            else r.yearly_amount
+        cash = cash.at[1:].add(net)
+    return cash
+
+
+def npv(cash, rate):
+    """Net present value of a yearly cash array at ``rate``."""
+    cash = jnp.asarray(cash)
+    years = jnp.arange(cash.shape[-1])
+    return jnp.sum(cash / (1.0 + rate) ** years, axis=-1)
+
+
+def irr(cash, guess: float = 0.1, iters: int = 60):
+    """Internal rate of return via damped Newton on NPV(r) = 0 (the role
+    of TEAL's IRR output)."""
+    cash = jnp.asarray(cash)
+
+    def body(r, _):
+        f = npv(cash, r)
+        df = jax.grad(lambda rr: npv(cash, rr))(r)
+        step = jnp.where(jnp.abs(df) > 1e-12, f / df, 0.0)
+        r_new = jnp.clip(r - step, -0.99, 10.0)
+        return r_new, None
+
+    r, _ = jax.lax.scan(body, jnp.asarray(guess), None, length=iters)
+    return r
+
+
+def profitability_index(cash, rate):
+    """PI = PV of in-flows (years >= 1) / |initial investment|."""
+    cash = jnp.asarray(cash)
+    years = jnp.arange(1, cash.shape[-1])
+    pv = jnp.sum(cash[1:] / (1.0 + rate) ** years)
+    return pv / jnp.abs(cash[0])

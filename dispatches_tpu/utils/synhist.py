@@ -1,0 +1,106 @@
+"""Synthetic LMP history generation via ARMA sampling.
+
+Capability counterpart of the reference's RAVEN integration
+(``dispatches/util/syn_hist_integration.py`` loads a serialized RAVEN
+ARMA ROM and calls ``generateSyntheticHistory(signal_name, set_years)``;
+``syn_hist_generation.py:21`` loops ROM sampling into DataFrames).  Here
+the ARMA model is explicit and sampling is a ``lax.scan`` vmapped over
+realizations — the Python sampling loop becomes one batched kernel.
+
+Model: seasonal-mean + ARMA(p, q) residual
+    y_t = mu_t + sum_i phi_i a_{t-i} + e_t + sum_j theta_j e_{t-j}
+with ``mu_t`` a periodic (e.g. 24-h) profile and Gaussian innovations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class ARMAModel:
+    phi: Sequence[float]  # AR coefficients
+    theta: Sequence[float]  # MA coefficients
+    sigma: float  # innovation std
+    seasonal_mean: Sequence[float]  # periodic mean profile (e.g. 24 values)
+
+    def sample(self, key, n_steps: int, n_realizations: int = 1):
+        """(n_realizations, n_steps) synthetic signals."""
+        phi = jnp.asarray(self.phi)
+        theta = jnp.asarray(self.theta)
+        mean = jnp.asarray(self.seasonal_mean)
+        p, q = len(self.phi), len(self.theta)
+
+        def one(key):
+            e = self.sigma * jax.random.normal(key, (n_steps + q,))
+
+            def body(carry, t):
+                a_hist = carry  # last p residuals
+                ar = jnp.dot(phi, a_hist) if p else 0.0
+                ma = jnp.dot(theta, jax.lax.dynamic_slice(e, (t,), (q,))[::-1]) if q else 0.0
+                a_t = ar + e[t + q] + ma
+                new_hist = (
+                    jnp.concatenate([jnp.array([a_t]), a_hist[:-1]])
+                    if p
+                    else a_hist
+                )
+                return new_hist, a_t
+
+            init = jnp.zeros((max(p, 1),))
+            _, resid = jax.lax.scan(body, init, jnp.arange(n_steps))
+            season = jnp.tile(mean, n_steps // len(self.seasonal_mean) + 1)[
+                :n_steps
+            ]
+            return season + resid
+
+        keys = jax.random.split(key, n_realizations)
+        return jax.vmap(one)(keys)
+
+    @classmethod
+    def fit(cls, signal: Sequence[float], p: int = 2, q: int = 0,
+            period: int = 24) -> "ARMAModel":
+        """Moment-based fit: periodic mean + Yule-Walker AR coefficients
+        (a practical stand-in for the RAVEN ROM training)."""
+        y = np.asarray(signal, dtype=float)
+        n_periods = len(y) // period
+        folded = y[: n_periods * period].reshape(n_periods, period)
+        mean = folded.mean(axis=0)
+        resid = (folded - mean).ravel()
+        if p:
+            r = np.array([
+                np.mean(resid[k:] * resid[: len(resid) - k]) for k in range(p + 1)
+            ])
+            R = np.array([[r[abs(i - j)] for j in range(p)] for i in range(p)])
+            phi = np.linalg.solve(R + 1e-12 * np.eye(p), r[1: p + 1])
+            sigma2 = r[0] - phi @ r[1: p + 1]
+        else:
+            phi = np.zeros(0)
+            sigma2 = resid.var()
+        return cls(
+            phi=list(phi),
+            theta=[0.0] * q,
+            sigma=float(np.sqrt(max(sigma2, 1e-12))),
+            seasonal_mean=list(mean),
+        )
+
+
+def generate_syn_realizations(
+    model: ARMAModel,
+    n_realizations: int,
+    n_steps: int,
+    seed: int = 0,
+    signal_name: str = "LMP",
+):
+    """Sample realizations into a list of dicts (the reference returns a
+    list of DataFrames, ``syn_hist_generation.py:21-73``)."""
+    key = jax.random.PRNGKey(seed)
+    samples = np.asarray(model.sample(key, n_steps, n_realizations))
+    return [
+        {"realization": i, signal_name: samples[i]}
+        for i in range(n_realizations)
+    ]

