@@ -153,7 +153,10 @@ def wind_battery_optimize(
     nlp = fs.compile(objective=objective, sense="max")
     res = solve_nlp(
         nlp,
-        options=IPMOptions(max_iter=int(input_params.get("max_iter", 300))),
+        options=IPMOptions(
+            max_iter=int(input_params.get("max_iter", 300)),
+            kkt=input_params.get("kkt", "auto"),
+        ),
     )
     sol = nlp.unravel(res.x)
 

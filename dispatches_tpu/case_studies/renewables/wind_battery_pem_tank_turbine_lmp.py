@@ -195,7 +195,11 @@ def wind_battery_pem_tank_turb_optimize(
 
     nlp = fs.compile(objective=objective, sense="max")
     res = solve_nlp(
-        nlp, options=IPMOptions(max_iter=int(input_params.get("max_iter", 500)))
+        nlp,
+        options=IPMOptions(
+            max_iter=int(input_params.get("max_iter", 500)),
+            kkt=input_params.get("kkt", "auto"),
+        ),
     )
     sol = nlp.unravel(res.x)
 

@@ -80,8 +80,19 @@ class CompiledNLP:
                 o += sz
             return sl, o
 
+        self._horizon = int(getattr(fs, "horizon", 0))
         self.eq_slices, self.m_eq = _probe(self._eq)
         self.ineq_slices, self.m_ineq = _probe(self._ineq)
+
+    def _ravel_tlast(self, out) -> jnp.ndarray:
+        """Ravel a residual time-LAST: a (T, k) block becomes k
+        contiguous length-T segments.  Row order within a block is
+        semantically free; this is the layout the structured KKT
+        detector segments on (solvers/structured.py)."""
+        out = jnp.asarray(out)
+        if out.ndim >= 2 and out.shape[0] == self._horizon:
+            out = jnp.moveaxis(out, 0, -1)
+        return jnp.ravel(out)
 
     # ------------------------------------------------------------------
 
@@ -122,7 +133,7 @@ class CompiledNLP:
         v = self._vals(x, params)
         p = Vals(params["p"])
         return jnp.concatenate(
-            [c.scale * jnp.ravel(c.fn(v, p)) for c in self._eq]
+            [c.scale * self._ravel_tlast(c.fn(v, p)) for c in self._eq]
         )
 
     def ineq(self, x: jnp.ndarray, params) -> jnp.ndarray:
@@ -131,7 +142,7 @@ class CompiledNLP:
         v = self._vals(x, params)
         p = Vals(params["p"])
         return jnp.concatenate(
-            [c.scale * jnp.ravel(c.fn(v, p)) for c in self._ineq]
+            [c.scale * self._ravel_tlast(c.fn(v, p)) for c in self._ineq]
         )
 
     # --- solution helpers --------------------------------------------

@@ -237,10 +237,20 @@ class Flowsheet:
         cname = name or f"arc_{src.name}__{dst.name}"
         pairs = [(src.keys[k], dst.keys[k]) for k in shared]
 
+        horizon = self.horizon
+
         def residual(v, p, _pairs=tuple(pairs)):
-            return jnp.concatenate(
-                [jnp.ravel(v[a] - v[b]) for a, b in _pairs]
-            )
+            # ravel each member time-LAST so multi-component streams
+            # (e.g. (T, n_comp) mole fractions) contribute contiguous
+            # length-T segments — the layout the structured KKT
+            # detector segments on (solvers/structured.py)
+            parts = []
+            for a, b in _pairs:
+                d = v[a] - v[b]
+                if d.ndim >= 2 and d.shape[0] == horizon:
+                    d = jnp.moveaxis(d, 0, -1)
+                parts.append(jnp.ravel(d))
+            return jnp.concatenate(parts)
 
         self.add_eq(cname, residual)
 

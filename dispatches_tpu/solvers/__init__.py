@@ -1,4 +1,20 @@
 from dispatches_tpu.solvers.ipm import IPMOptions, IPMResult, make_ipm_solver, solve_nlp
+from dispatches_tpu.solvers.pdlp import (
+    LPResult,
+    PDLPOptions,
+    make_lp_data,
+    make_pdlp_solver,
+)
 from dispatches_tpu.solvers.factory import SolverFactory
 
-__all__ = ["IPMOptions", "IPMResult", "make_ipm_solver", "solve_nlp", "SolverFactory"]
+__all__ = [
+    "IPMOptions",
+    "IPMResult",
+    "make_ipm_solver",
+    "solve_nlp",
+    "LPResult",
+    "PDLPOptions",
+    "make_lp_data",
+    "make_pdlp_solver",
+    "SolverFactory",
+]

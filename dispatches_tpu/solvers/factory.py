@@ -4,16 +4,17 @@
 
 Here the factory hands out solver objects with a ``solve(nlp, params=...)``
 method so drivers read like the reference's, while the execution path is
-the batched JAX IPM.
+the batched JAX solvers: the reference's CBC (LP) maps to the first-order
+PDLP kernel with an IPM fallback for non-affine models, and IPOPT (NLP)
+maps to the interior-point kernel.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
+from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
 
 
 class _IPMSolver:
@@ -33,6 +34,84 @@ class _IPMSolver:
             print(
                 f"[dispatches_tpu.ipm] iters={int(res.iterations)} "
                 f"kkt_error={float(res.kkt_error):.3e} converged={bool(res.converged)} "
+                f"status={int(res.status)} obj={float(res.obj):.8g}"
+            )
+        return res
+
+
+class _PDLPSolver:
+    """LP path (reference CBC role).  Falls back to the IPM when the
+    model's affinity probe fails, so reference-style drivers can call
+    SolverFactory("cbc") without knowing whether their flowsheet
+    configuration happens to be linear.  Options are split by name
+    between the two kernels so e.g. ``kkt=`` (IPM-only) or ``dtype=``
+    (PDLP-only) survive whichever path runs."""
+
+    name = "pdlp"
+
+    _PDLP_FIELDS = set(PDLPOptions.__dataclass_fields__)
+    _IPM_FIELDS = set(IPMOptions._fields)
+
+    def __init__(self, **options):
+        self.options = options
+        # (id(nlp), frozen options) -> ("pdlp"|"ipm", jitted solver):
+        # the reference's per-scenario SolverFactory("cbc").solve loop
+        # must not pay LP extraction + XLA compile per call, on either
+        # the affine or the fallback path
+        self._cache = {}
+
+    def solve(self, nlp, params=None, x0=None, tee: bool = False, **opt_overrides):
+        """NOTE: ``x0`` is honored only on the IPM fallback path — PDHG
+        has no warm-start advantage at these tolerances, so the PDLP
+        path always cold-starts (flagged on ``tee``)."""
+        opts = dict(self.options)
+        opts.update(opt_overrides)
+        params = nlp.default_params() if params is None else params
+        key = (id(nlp), tuple(sorted(opts.items())))
+        kind_solver = self._cache.get(key)
+        if kind_solver is None:
+            lp_kw = {k: v for k, v in opts.items() if k in self._PDLP_FIELDS}
+            lp_kw.setdefault("tol", 1e-8)
+            lp_kw.setdefault("dtype", "float64")
+            try:
+                kind_solver = (
+                    "pdlp",
+                    jax.jit(make_pdlp_solver(nlp, PDLPOptions(**lp_kw))),
+                )
+            except ValueError:  # not affine: hand off to the NLP kernel
+                if tee:
+                    print("[dispatches_tpu.pdlp] model not affine; using IPM")
+                ipm_kw = {
+                    k: v for k, v in opts.items() if k in self._IPM_FIELDS
+                }
+                kind_solver = (
+                    "ipm",
+                    jax.jit(
+                        make_ipm_solver(
+                            nlp, IPMOptions(**ipm_kw) if ipm_kw else IPMOptions()
+                        )
+                    ),
+                )
+            self._cache[key] = kind_solver
+        kind, solver = kind_solver
+        if kind == "ipm":
+            res = solver(params) if x0 is None else solver(params, x0)
+            if tee:
+                print(
+                    f"[dispatches_tpu.ipm] iters={int(res.iterations)} "
+                    f"kkt_error={float(res.kkt_error):.3e} "
+                    f"converged={bool(res.converged)} "
+                    f"status={int(res.status)} obj={float(res.obj):.8g}"
+                )
+            return res
+        if x0 is not None and tee:
+            print("[dispatches_tpu.pdlp] x0 ignored (PDHG cold start)")
+        res = solver(params)
+        if tee:
+            print(
+                f"[dispatches_tpu.pdlp] iters={int(res.iters)} "
+                f"pr={float(res.pr_err):.3e} du={float(res.du_err):.3e} "
+                f"gap={float(res.gap):.3e} converged={bool(res.converged)} "
                 f"obj={float(res.obj):.8g}"
             )
         return res
@@ -40,11 +119,12 @@ class _IPMSolver:
 
 _REGISTRY = {
     "ipm": _IPMSolver,
-    # aliases so reference-style driver code ports verbatim: both of the
-    # reference's workhorse solvers map onto the same TPU IPM kernel
-    # (CBC handled LPs, IPOPT handled NLPs — one kernel covers both here).
+    "pdlp": _PDLPSolver,
+    # aliases so reference-style driver code ports verbatim: the
+    # reference's LP workhorse (CBC) maps to the first-order LP kernel,
+    # its NLP workhorse (IPOPT) to the interior-point kernel.
     "ipopt": _IPMSolver,
-    "cbc": _IPMSolver,
+    "cbc": _PDLPSolver,
 }
 
 

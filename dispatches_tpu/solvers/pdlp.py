@@ -1,0 +1,347 @@
+"""Batched first-order LP solver (restarted averaged PDHG, PDLP-style).
+
+This is the TPU-native replacement for the reference's CBC subprocess LP
+path (``wind_battery_LMP.py:255`` in the reference; SURVEY.md §2.6 "CBC →
+LP interior-point/PDHG path on TPU", cf. MPAX in PAPERS.md).  Rationale:
+TPUs have no native float64 — the f64-emulated interior-point iteration
+is ~90x slower than f32 on a v5e chip (measured), while a primal-dual
+hybrid-gradient iteration is two matmuls per step and converges fine in
+float32 given diagonal (Ruiz) equilibration, iterate averaging, and
+adaptive restarts.  The IPM (``ipm.py``) remains the f64 NLP path.
+
+The LP is extracted from a :class:`CompiledNLP` whose residuals are
+affine in ``x``:
+
+    min  c(p)'x           s.t.  K x = q(p),   G x <= h(p),   l <= x <= u
+
+``K``/``G`` (the Jacobians) must not depend on the scenario params — this
+holds for every LP case in the reference (params enter objective
+coefficients and right-hand sides only) and is probe-checked at build
+time.  ``c``/``q``/``h`` are re-evaluated per scenario inside the jitted
+solve, so one compiled solver sweeps an LMP-scenario batch under
+``vmap`` (the 366-signal annual sweep, SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LPResult(NamedTuple):
+    x: jnp.ndarray          # solution in the SCALED decision space (use
+    #                         nlp.unravel(res.x) for physical values)
+    obj: jnp.ndarray        # objective in the user's declared sense
+    converged: jnp.ndarray  # bool: relative KKT error below tol
+    iters: jnp.ndarray
+    pr_err: jnp.ndarray     # relative primal infeasibility (inf-norm)
+    du_err: jnp.ndarray     # relative dual infeasibility (inf-norm)
+    gap: jnp.ndarray        # relative primal-dual objective gap
+
+
+@dataclass(frozen=True)
+class PDLPOptions:
+    tol: float = 1e-6            # relative KKT tolerance (all three errs)
+    max_iter: int = 20000
+    check_every: int = 40        # iterations between restart/term checks
+    restart_beta: float = 0.36   # sufficient-decay factor (PDLP's beta)
+    ruiz_iters: int = 10
+    dtype: str = "float32"       # f32 is the TPU-native fast path; tests
+    #                              on CPU may pick float64 for tight parity
+    omega0: float = 1.0          # initial primal weight
+
+
+def _ruiz_equilibrate(A, iters):
+    """Symmetric Ruiz scaling: returns (D_r, D_c) with
+    Ahat = D_r[:,None] * A * D_c[None,:] having rows/cols of ~unit
+    inf-norm.  Computed once on the host in f64."""
+    m, n = A.shape
+    dr = np.ones(m)
+    dc = np.ones(n)
+    Ah = A.copy()
+    for _ in range(iters):
+        rn = np.sqrt(np.maximum(np.abs(Ah).max(axis=1), 1e-12))
+        cn = np.sqrt(np.maximum(np.abs(Ah).max(axis=0), 1e-12))
+        dr /= rn
+        dc /= cn
+        Ah = dr[:, None] * A * dc[None, :]
+    return dr, dc
+
+
+def _power_norm(A, iters=60):
+    """||A||_2 estimate by power iteration on A'A (host, f64)."""
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(A.shape[1])
+    v /= np.linalg.norm(v) + 1e-30
+    s = 1.0
+    for _ in range(iters):
+        w = A.T @ (A @ v)
+        s = np.linalg.norm(w)
+        v = w / (s + 1e-30)
+    return float(np.sqrt(s))
+
+
+def make_lp_data(nlp, probe_params=None):
+    """Materialize the constant LP structure (Jacobians, bounds) from an
+    affine :class:`CompiledNLP`.  Probe-checks affinity and that the
+    Jacobians are parameter-independent; raises ValueError otherwise."""
+    params = probe_params if probe_params is not None else nlp.default_params()
+    n = nlp.n
+    x0 = jnp.zeros(n)
+
+    K = np.asarray(jax.jacfwd(lambda x: nlp.eq(x, params))(x0))
+    G = np.asarray(jax.jacfwd(lambda x: nlp.ineq(x, params))(x0))
+    c0 = np.asarray(jax.grad(lambda x: nlp.objective(x, params))(x0))
+
+    # affinity probe: residual(x) - residual(0) must equal J @ x
+    rng = np.random.default_rng(1)
+    xt = jnp.asarray(rng.standard_normal(n))
+    r_eq = np.asarray(nlp.eq(xt, params) - nlp.eq(x0, params))
+    r_in = np.asarray(nlp.ineq(xt, params) - nlp.ineq(x0, params))
+    ct = np.asarray(jax.grad(lambda x: nlp.objective(x, params))(xt))
+    scale_eq = 1.0 + np.abs(r_eq).max() if r_eq.size else 1.0
+    scale_in = 1.0 + np.abs(r_in).max() if r_in.size else 1.0
+    if (
+        (r_eq.size and np.abs(r_eq - K @ np.asarray(xt)).max() / scale_eq > 1e-8)
+        or (r_in.size and np.abs(r_in - G @ np.asarray(xt)).max() / scale_in > 1e-8)
+        or np.abs(ct - c0).max() / (1.0 + np.abs(c0).max()) > 1e-8
+    ):
+        raise ValueError(
+            "model is not affine in x: use the IPM (make_ipm_solver) instead"
+        )
+
+    return {"K": K, "G": G, "lb": np.asarray(nlp.lb), "ub": np.asarray(nlp.ub)}
+
+
+def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
+    """Build ``solver(params) -> LPResult`` for an affine CompiledNLP.
+
+    The returned callable is jit/vmap-compatible; Jacobian structure is
+    baked in, per-scenario ``c``/``q``/``h`` are re-derived from
+    ``params`` inside the trace (cheap: one residual eval at x=0 plus
+    one objective gradient)."""
+    opt = options
+    dtype = jnp.dtype(opt.dtype)
+    data = lp_data if lp_data is not None else make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    m_eq, m_in = K.shape[0], G.shape[0]
+    n = nlp.n
+
+    A = np.vstack([K, G]) if m_in else K
+    dr, dc = _ruiz_equilibrate(A, opt.ruiz_iters)
+    Ah = dr[:, None] * A * dc[None, :]
+    norm_A = max(_power_norm(Ah), 1e-12)
+
+    Ah_raw = jnp.asarray(Ah, dtype)
+    AhT_raw = jnp.asarray(Ah.T, dtype)  # explicit transpose: keeps both
+    # matmuls in row-major layout for the MXU
+
+    # TPU matmuls default to bfloat16 inputs (~3 decimal digits): the
+    # PDHG fixed point then floors at ~1e-3 relative error (measured).
+    # HIGHEST requests full-f32 MXU passes; these matvecs are tiny, so
+    # the extra passes are free.
+    _prec = jax.lax.Precision.HIGHEST
+
+    def Amv(v):
+        return jnp.matmul(Ah_raw, v, precision=_prec)
+
+    def ATmv(v):
+        return jnp.matmul(AhT_raw, v, precision=_prec)
+    dr_j = jnp.asarray(dr, dtype)
+    dc_j = jnp.asarray(dc, dtype)
+    # scaled-space bounds: x = xhat * dc  =>  xhat in [lb/dc, ub/dc]
+    lb_h = jnp.asarray(data["lb"] / dc, dtype)
+    ub_h = jnp.asarray(data["ub"] / dc, dtype)
+    is_eq = jnp.concatenate([jnp.ones(m_eq, bool), jnp.zeros(m_in, bool)])
+    inv_step = 1.0 / norm_A
+
+    def _rhs(params):
+        """Per-scenario (c, b) in the equilibrated space (f64 eval, cast)."""
+        x0 = jnp.zeros(n)
+        c = jax.grad(lambda x: nlp.objective(x, params))(x0)
+        q = -nlp.eq(x0, params)
+        h = -nlp.ineq(x0, params)
+        b = jnp.concatenate([q, h]) if m_in else q
+        return (c * dc).astype(dtype), (b * dr).astype(dtype)
+
+    def _kkt_errors(x, z, c, b):
+        """Relative primal/dual/gap errors in the equilibrated space."""
+        ax = Amv(x)
+        viol = jnp.where(is_eq, jnp.abs(ax - b), jnp.maximum(ax - b, 0.0))
+        pr = _inf(viol) / (1.0 + _inf(b))
+        # reduced costs: r = c + A'z; dual residual = the part of r not
+        # attributable to a finite bound's multiplier
+        r = c + ATmv(z)
+        rd = r - jnp.where(r > 0, jnp.where(jnp.isfinite(lb_h), r, 0.0),
+                           jnp.where(jnp.isfinite(ub_h), r, 0.0))
+        du = _inf(rd) / (1.0 + _inf(c))
+        pobj = c @ x
+        lb_fin = jnp.where(jnp.isfinite(lb_h), lb_h, 0.0)
+        ub_fin = jnp.where(jnp.isfinite(ub_h), ub_h, 0.0)
+        dobj = -(b @ z) + jnp.sum(
+            jnp.clip(r, 0.0, None) * lb_fin + jnp.clip(r, None, 0.0) * ub_fin
+        )
+        gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+        return pr, du, gap
+
+    def _inf(v):
+        return jnp.max(jnp.abs(v)) if v.shape[0] else jnp.asarray(0.0, dtype)
+
+    def _pdhg_sweep(x, z, xs, zs, c, b, omega, k):
+        """k fixed PDHG steps, extending the running average sums."""
+        tau = omega * inv_step
+        sig = inv_step / omega
+
+        def body(carry, _):
+            x, z, xs, zs = carry
+            xn = jnp.clip(x - tau * (c + ATmv(z)), lb_h, ub_h)
+            z_t = z + sig * (Amv(2.0 * xn - x) - b)
+            zn = jnp.where(is_eq, z_t, jnp.clip(z_t, 0.0, None))
+            return (xn, zn, xs + xn, zs + zn), None
+
+        (x, z, xs, zs), _ = jax.lax.scan(body, (x, z, xs, zs), None, length=k)
+        return x, z, xs, zs
+
+    def solver(params) -> LPResult:
+        c, b = _rhs(params)
+        x = jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h)
+        z = jnp.zeros(m_eq + m_in, dtype)
+
+        # initial primal weight: in this parameterization (tau = omega/|A|,
+        # sigma = 1/(omega |A|)) the primal iterate must travel ~|x*| and
+        # the dual ~|z*|, so omega ~ |b|/|c| balances them (PDLP's omega_0
+        # with the step roles transposed).  Measured on the battery LP:
+        # omega=1 needs ~90k iterations, |b|/|c| needs <1k.
+        nb, nc = jnp.linalg.norm(b), jnp.linalg.norm(c)
+        omega0 = jnp.where(
+            (nb > 0.0) & (nc > 0.0),
+            jnp.clip(nb / nc, 1e-4, 1e6),
+            jnp.asarray(opt.omega0, dtype),
+        ).astype(dtype)
+
+        def err_of(x_, z_):
+            pr, du, gap = _kkt_errors(x_, z_, c, b)
+            return jnp.maximum(jnp.maximum(pr, du), gap), (pr, du, gap)
+
+        e0, _ = err_of(x, z)
+
+        def cond(s):
+            return jnp.logical_and(s["it"] < opt.max_iter, ~s["done"])
+
+        def step(s):
+            x1, z1, xs, zs = _pdhg_sweep(
+                s["x"], s["z"], s["xs"], s["zs"], c, b, s["omega"], opt.check_every
+            )
+            k = s["k"] + opt.check_every
+            xa, za = xs / k, zs / k
+            e_cur, _ = err_of(x1, z1)
+            e_avg, _ = err_of(xa, za)
+            use_avg = e_avg < e_cur
+            xc = jnp.where(use_avg, xa, x1)
+            zc = jnp.where(use_avg, za, z1)
+            e_c = jnp.minimum(e_avg, e_cur)
+
+            # PDLP restart criteria: sufficient decay since the last
+            # restart, or an "artificial" restart when the current epoch
+            # has run long without one (keeps the averaged sequence from
+            # going stale — PDLP §restarts)
+            sufficient = e_c <= opt.restart_beta * s["e_r"]
+            artificial = k >= jnp.maximum(0.36 * s["it"], 8 * opt.check_every)
+            do_restart = jnp.logical_or(sufficient, artificial)
+
+            # primal-weight rebalancing on restart (simplified PDLP rule;
+            # in this parameterization omega tracks primal/dual travel)
+            dx = _inf(xc - s["xr"])
+            dz = _inf(zc - s["zr"])
+            omega_new = jnp.clip(
+                jnp.exp(
+                    0.5 * jnp.log(s["omega"])
+                    + 0.5 * jnp.log(jnp.maximum(dx, 1e-10) / jnp.maximum(dz, 1e-10))
+                ),
+                1e-6,
+                1e8,
+            )
+            omega = jnp.where(do_restart, omega_new, s["omega"])
+            xr = jnp.where(do_restart, xc, s["xr"])
+            zr = jnp.where(do_restart, zc, s["zr"])
+            e_r = jnp.where(do_restart, e_c, s["e_r"])
+            x_next = jnp.where(do_restart, xc, x1)
+            z_next = jnp.where(do_restart, zc, z1)
+            zero_x = jnp.zeros_like(x1)
+            zero_z = jnp.zeros_like(z1)
+
+            # best-iterate tracking + stall exit: f32 lanes can floor
+            # just above tol; without this, one floored lane in a vmapped
+            # batch drags every lane to max_iter (the whole sweep's
+            # wall-clock is the worst lane's)
+            improved = e_c < 0.95 * s["e_b"]
+            new_best = e_c < s["e_b"]
+            e_b = jnp.where(new_best, e_c, s["e_b"])
+            xb = jnp.where(new_best, xc, s["xb"])
+            zb = jnp.where(new_best, zc, s["zb"])
+            stall = jnp.where(improved, 0, s["stall"] + 1)
+            # a lane may exit on stall only once it is already close to
+            # tol (the f32 floor case); a lane still far away keeps
+            # going — PDHG error is non-monotone and plateaus routinely
+            # before a restart unlocks progress
+            floored = jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12)
+            done = jnp.logical_or(
+                s["done"], jnp.logical_or(e_b < opt.tol, floored)
+            )
+            return {
+                "x": x_next,
+                "z": z_next,
+                "xs": jnp.where(do_restart, zero_x, xs),
+                "zs": jnp.where(do_restart, zero_z, zs),
+                "k": jnp.where(do_restart, 0, k),
+                "xr": xr,
+                "zr": zr,
+                "e_r": e_r,
+                "omega": omega,
+                "it": s["it"] + opt.check_every,
+                "done": done,
+                "e_b": e_b,
+                "stall": stall,
+                "xb": xb,
+                "zb": zb,
+            }
+
+        init = {
+            "x": x,
+            "z": z,
+            "xs": jnp.zeros_like(x),
+            "zs": jnp.zeros_like(z),
+            "k": jnp.asarray(0, jnp.int32),
+            "xr": x,
+            "zr": z,
+            "e_r": e0,
+            "omega": omega0,
+            "it": jnp.asarray(0, jnp.int32),
+            "done": e0 < opt.tol,
+            "e_b": e0,
+            "stall": jnp.asarray(0, jnp.int32),
+            "xb": x,
+            "zb": z,
+        }
+        out = jax.lax.while_loop(cond, step, init)
+        xb, zb = out["xb"], out["zb"]
+        pr, du, gap = _kkt_errors(xb, zb, c, b)
+        x_scaled = xb * dc_j  # back to the CompiledNLP's scaled space
+        # evaluate the model objective directly (keeps any constant term
+        # that c'x misses, and the user's declared sense)
+        obj = nlp.user_objective(x_scaled.astype(jnp.result_type(float)), params)
+        return LPResult(
+            x=x_scaled,
+            obj=obj,
+            converged=jnp.maximum(jnp.maximum(pr, du), gap) < opt.tol,
+            iters=out["it"],
+            pr_err=pr,
+            du_err=du,
+            gap=gap,
+        )
+
+    return solver

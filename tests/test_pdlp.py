@@ -1,0 +1,150 @@
+"""PDLP (restarted PDHG) LP solver: parity vs scipy/HiGHS.
+
+Mirrors the reference's reliance on CBC for LP price-takers
+(``wind_battery_LMP.py:255`` in the reference): the first-order TPU path
+must reproduce the same optima the simplex/IPM CPU solvers find.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
+
+
+def _battery_lp(T=24):
+    fs = Flowsheet(horizon=T)
+    for n in ["wind_elec", "grid", "batt_in", "batt_out"]:
+        fs.add_var(n, lb=0, ub=1e6, scale=1e3)
+    fs.add_var("soc", lb=0, ub=4e6, scale=1e3)
+    fs.add_var("soc0", shape=(), lb=0)
+    fs.fix("soc0", 0.0)
+    fs.add_param("lmp", np.full(T, 0.02))
+    fs.add_param("wind_cap_cf", np.full(T, 400e3))
+    fs.add_eq("power_balance", lambda v, p: v["wind_elec"] - v["grid"] - v["batt_in"])
+    fs.add_eq(
+        "soc_evolution",
+        lambda v, p: v["soc"]
+        - tshift(v["soc"], v["soc0"])
+        - 0.95 * v["batt_in"]
+        + v["batt_out"] / 0.95,
+    )
+    fs.add_ineq("wind_cf", lambda v, p: v["wind_elec"] - p["wind_cap_cf"])
+    fs.add_ineq("batt_p_in", lambda v, p: v["batt_in"] - 300e3)
+    fs.add_ineq("batt_p_out", lambda v, p: v["batt_out"] - 300e3)
+    fs.add_eq("periodic", lambda v, p: v["soc"][-1] - v["soc0"])
+    return fs.compile(
+        objective=lambda v, p: jnp.sum(p["lmp"] * (v["grid"] + v["batt_out"])),
+        sense="max",
+    )
+
+
+def _highs_battery(T, lmp, cf):
+    n = 5 * T
+    iw, ig, ibi, ibo, isoc = (slice(k * T, (k + 1) * T) for k in range(5))
+    A = np.zeros((2 * T + 1, n))
+    b = np.zeros(2 * T + 1)
+    for t in range(T):
+        A[t, iw.start + t] = 1.0
+        A[t, ig.start + t] = -1.0
+        A[t, ibi.start + t] = -1.0
+        A[T + t, isoc.start + t] = 1.0
+        if t > 0:
+            A[T + t, isoc.start + t - 1] = -1.0
+        A[T + t, ibi.start + t] = -0.95
+        A[T + t, ibo.start + t] = 1.0 / 0.95
+    A[2 * T, isoc.stop - 1] = 1.0
+    c = np.zeros(n)
+    c[ig] = -lmp
+    c[ibo] = -lmp
+    bounds = (
+        [(0.0, cf[t]) for t in range(T)]
+        + [(0.0, 1e6)] * T
+        + [(0.0, 300e3)] * T
+        + [(0.0, 300e3)] * T
+        + [(0.0, 4e6)] * T
+    )
+    res = linprog(c, A_eq=A, b_eq=b, bounds=bounds, method="highs")
+    assert res.status == 0
+    return -res.fun
+
+
+def test_pdlp_battery_lp_parity_f64():
+    T = 24
+    nlp = _battery_lp(T)
+    solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-8, dtype="float64"))
+    params = nlp.default_params()
+    res = jax.jit(solver)(params)
+    assert bool(res.converged)
+    ref = _highs_battery(T, np.full(T, 0.02), np.full(T, 400e3))
+    assert float(res.obj) == pytest.approx(ref, rel=1e-6)
+
+
+def test_pdlp_battery_lp_parity_f32_batch():
+    """f32 is the TPU fast path: 1e-4 relative objective parity across a
+    scenario batch (the bench configuration)."""
+    T = 24
+    nlp = _battery_lp(T)
+    solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-5, dtype="float32"))
+    params = nlp.default_params()
+    rng = np.random.default_rng(0)
+    N = 8
+    lmps = 0.02 + 0.015 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (N, 1))) / 24
+    )
+    cfs = 400e3 * (0.4 + 0.6 * rng.random((N, T)))
+    batched = {
+        "p": {"lmp": lmps, "wind_cap_cf": cfs},
+        "fixed": params["fixed"],
+    }
+    vsolve = jax.jit(
+        jax.vmap(solver, in_axes=({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},))
+    )
+    res = vsolve(batched)
+    objs = np.asarray(res.obj)
+    assert bool(np.all(np.asarray(res.converged)))
+    for i in range(N):
+        ref = _highs_battery(T, lmps[i], cfs[i])
+        assert objs[i] == pytest.approx(ref, rel=1e-4), f"scenario {i}"
+
+
+def test_pdlp_rejects_nonlinear():
+    fs = Flowsheet(horizon=4)
+    fs.add_var("x", lb=0, ub=10)
+    fs.add_eq("quad", lambda v, p: v["x"] ** 2 - 1.0)
+    nlp = fs.compile(objective=lambda v, p: jnp.sum(v["x"]))
+    with pytest.raises(ValueError, match="not affine"):
+        make_pdlp_solver(nlp)
+
+
+def test_pdlp_random_lps_vs_highs():
+    """Random feasible-by-construction box LPs, f64 parity."""
+    rng = np.random.default_rng(42)
+    for trial in range(3):
+        n, m = 30, 12
+        A = rng.standard_normal((m, n))
+        xfeas = rng.uniform(0.5, 1.5, n)
+        b = A @ xfeas
+        cvec = rng.standard_normal(n)
+
+        fs = Flowsheet(horizon=n)
+        fs.add_var("x", lb=0.0, ub=3.0)
+        fs.add_param("b", b)
+        fs.add_eq("rows", lambda v, p, A=A: jnp.asarray(A) @ v["x"] - p["b"])
+        nlp = fs.compile(
+            objective=lambda v, p, c=cvec: jnp.dot(jnp.asarray(c), v["x"])
+        )
+        solver = make_pdlp_solver(
+            nlp, PDLPOptions(tol=1e-8, dtype="float64", max_iter=60000)
+        )
+        res = jax.jit(solver)(nlp.default_params())
+        ref = linprog(
+            cvec, A_eq=A, b_eq=b, bounds=[(0.0, 3.0)] * n, method="highs"
+        )
+        assert ref.status == 0
+        assert bool(res.converged), f"trial {trial} did not converge"
+        assert float(res.obj) == pytest.approx(ref.fun, rel=1e-6, abs=1e-6)

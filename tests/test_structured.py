@@ -1,0 +1,172 @@
+"""Structured (bordered block-tridiagonal) KKT solver: detection and
+numerical parity against a dense assembled solve.
+
+The structured path is the long-horizon scaling mechanism (SURVEY.md §5
+"banded/block-tridiagonal KKT"); correctness bar: the solve must agree
+with the dense factorization to ~1e-8 on a time-structured model with
+banded constraints, a periodic (border) row, and a scalar design
+variable (border column)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.solvers.structured import (
+    detect_time_structure,
+    make_structured_kkt,
+)
+
+
+def _model(T=24):
+    """Battery arbitrage with a free design variable (border column) and
+    a periodic row (border row); quadratic degradation term exercises a
+    nonzero banded Hessian."""
+    fs = Flowsheet(horizon=T)
+    fs.add_var("charge", lb=0, ub=500.0)
+    fs.add_var("discharge", lb=0, ub=500.0)
+    fs.add_var("soc", lb=0, ub=4000.0)
+    fs.add_var("cap", shape=(), lb=10.0, ub=5000.0)  # design var: border
+    fs.add_param("price", np.sin(np.arange(T)) * 30 + 40.0)
+    fs.add_eq(
+        "soc_evolution",
+        lambda v, p: v["soc"] - tshift(v["soc"], jnp.asarray(0.0))
+        - 0.9 * v["charge"] + v["discharge"] / 0.9,
+    )
+    fs.add_ineq("soc_cap", lambda v, p: v["soc"] - v["cap"])
+    fs.add_eq("periodic", lambda v, p: v["soc"][-1] - 0.0)
+
+    def obj(v, p):
+        rev = jnp.sum(p["price"] * (v["discharge"] - v["charge"]))
+        deg = 0.01 * jnp.sum((v["charge"] + v["discharge"]) ** 2)
+        return rev - deg - 3.0 * v["cap"]
+
+    return fs.compile(objective=obj, sense="max")
+
+
+def test_detect_structure():
+    T = 24
+    nlp = _model(T)
+    ts = detect_time_structure(nlp)
+    assert ts is not None
+    assert ts.T == T
+    # 3 time vars + 1 banded-ineq slack per period
+    assert ts.nps == 4
+    # soc_evolution + soc_cap rows per period
+    assert ts.npc == 2
+    # border: cap (1 y slot), periodic row (1 c row)
+    assert ts.n_by == 1
+    assert ts.n_bc == 1
+
+
+def test_detect_rejects_nonbanded():
+    T = 16
+    fs = Flowsheet(horizon=T)
+    fs.add_var("x", lb=0, ub=10.0)
+    # cumulative-sum constraint couples all periods: not banded
+    fs.add_eq("cum", lambda v, p: jnp.cumsum(v["x"]) - 1.0)
+    nlp = fs.compile(objective=lambda v, p: jnp.sum(v["x"]))
+    ts = detect_time_structure(nlp)
+    # the only length-T constraint is non-banded -> no period rows
+    assert ts is None
+
+
+def test_detect_rejects_nonbanded_hessian():
+    T = 16
+    fs = Flowsheet(horizon=T)
+    fs.add_var("x", lb=0, ub=10.0)
+    fs.add_eq("local", lambda v, p: v["x"] - 1.0)
+    # (sum x)^2 couples every pair of periods in the Hessian
+    nlp = fs.compile(objective=lambda v, p: jnp.sum(v["x"]) ** 2)
+    assert detect_time_structure(nlp) is None
+
+
+def test_structured_vs_dense_kkt():
+    T = 24
+    nlp = _model(T)
+    ts = detect_time_structure(nlp)
+    assert ts is not None
+
+    n_x, m_eq, m_in = nlp.n, nlp.m_eq, nlp.m_ineq
+    n_y = n_x + m_in
+    m = m_eq + m_in
+    params = nlp.default_params()
+    rng = np.random.default_rng(3)
+    y = jnp.asarray(rng.uniform(0.5, 1.5, n_y))
+    lam = jnp.asarray(rng.standard_normal(m))
+
+    def cons_fn(yv):
+        x, s = yv[:n_x], yv[n_x:]
+        return jnp.concatenate([nlp.eq(x, params), nlp.ineq(x, params) + s])
+
+    def lag(yv):
+        return nlp.objective(yv[:n_x], params) + cons_fn(yv) @ lam
+
+    lag_grad = jax.grad(lag)
+
+    Sigma = jnp.asarray(rng.uniform(0.5, 2.0, n_y))
+    r1 = jnp.asarray(rng.standard_normal(n_y))
+    c = jnp.asarray(rng.standard_normal(m))
+    dw, dc = 1e-8, 1e-8
+
+    solve = make_structured_kkt(ts, n_y, m)
+    dy, dlam, ok = jax.jit(
+        lambda: solve(cons_fn, lag_grad, y, Sigma, r1, c, dw, dc)
+    )()
+    assert bool(ok)
+
+    # dense reference
+    W = np.asarray(jax.hessian(lag)(y))
+    J = np.asarray(jax.jacfwd(cons_fn)(y))
+    H = W + np.diag(np.asarray(Sigma)) + dw * np.eye(n_y)
+    KKT = np.block([[H, J.T], [J, -dc * np.eye(m)]])
+    rhs = np.concatenate([-np.asarray(r1), -np.asarray(c)])
+    sol = np.linalg.solve(KKT, rhs)
+
+    np.testing.assert_allclose(np.asarray(dy), sol[:n_y], rtol=1e-7, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(dlam), sol[n_y:], rtol=1e-7, atol=1e-8)
+
+
+@pytest.mark.parametrize("T", [8, 17, 33])
+def test_structured_vs_dense_kkt_odd_horizons(T):
+    """Horizon lengths not divisible by 3 exercise the color wraparound."""
+    nlp = _model(T)
+    ts = detect_time_structure(nlp)
+    assert ts is not None
+    n_x, m_eq, m_in = nlp.n, nlp.m_eq, nlp.m_ineq
+    n_y, m = n_x + m_in, m_eq + m_in
+    params = nlp.default_params()
+    rng = np.random.default_rng(T)
+    y = jnp.asarray(rng.uniform(0.5, 1.5, n_y))
+    lam = jnp.asarray(rng.standard_normal(m))
+
+    def cons_fn(yv):
+        x, s = yv[:n_x], yv[n_x:]
+        return jnp.concatenate([nlp.eq(x, params), nlp.ineq(x, params) + s])
+
+    lag_grad = jax.grad(
+        lambda yv: nlp.objective(yv[:n_x], params) + cons_fn(yv) @ lam
+    )
+    Sigma = jnp.asarray(rng.uniform(0.5, 2.0, n_y))
+    r1 = jnp.asarray(rng.standard_normal(n_y))
+    c = jnp.asarray(rng.standard_normal(m))
+
+    solve = make_structured_kkt(ts, n_y, m)
+    dy, dlam, ok = solve(cons_fn, lag_grad, y, Sigma, r1, c, 1e-8, 1e-8)
+    assert bool(ok)
+
+    W = np.asarray(
+        jax.hessian(
+            lambda yv: nlp.objective(yv[:n_x], params) + cons_fn(yv) @ lam
+        )(y)
+    )
+    J = np.asarray(jax.jacfwd(cons_fn)(y))
+    H = W + np.diag(np.asarray(Sigma)) + 1e-8 * np.eye(n_y)
+    KKT = np.block([[H, J.T], [J, -1e-8 * np.eye(m)]])
+    sol = np.linalg.solve(
+        KKT, np.concatenate([-np.asarray(r1), -np.asarray(c)])
+    )
+    np.testing.assert_allclose(np.asarray(dy), sol[:n_y], rtol=1e-7, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(dlam), sol[n_y:], rtol=1e-7, atol=1e-8)
