@@ -3,29 +3,140 @@
 North-star metric (BASELINE.json): throughput of 24-h wind+battery
 price-taker solves across an LMP-scenario batch — the workload the
 reference runs as one serial CBC/IPOPT subprocess per scenario
-(``wind_battery_LMP.py:255``, SURVEY.md §3.1).  The baseline denominator
-is the measured single-scenario solve time on the same machine
-(batch=1, the reference's serial pattern); the headline value is
-batched solves/second, ``vs_baseline`` = speedup over serial.
+(``wind_battery_LMP.py:255``, SURVEY.md §3.1).  The baseline
+denominator is an IPOPT-class serial CPU loop: scipy's HiGHS solving
+the identical LP one scenario at a time (the reference's serial
+pattern; HiGHS is if anything *faster* than IPOPT on LPs, so the
+reported speedup is conservative).  The headline value is batched
+solves/second on the accelerator; ``vs_baseline`` = speedup over that
+serial CPU loop per BASELINE.md's >=50x north star.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness: the TPU tunnel ("axon" backend) is known-flaky at snapshot
+time.  Backend liveness is probed in a subprocess with bounded retries;
+if the accelerator never comes up, the benchmark falls back to CPU and
+still reports a number (tagged via the "backend" key) rather than
+crashing with rc=1 (VERDICT round 1, weak #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
+def _probe_backend(retries: int = 3, wait_s: float = 10.0) -> bool:
+    """Return True iff a (non-CPU) JAX backend initializes in a fresh
+    subprocess.  Probing in a subprocess keeps a failed init from being
+    cached in this process, so a later retry can genuinely succeed.
+    A downed tunnel HANGS device init rather than erroring (observed),
+    so the probe timeout is kept short — worst case ~3.5 min before the
+    CPU fallback kicks in."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)"
+    )
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                timeout=60,
+            )
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < retries - 1:
+            time.sleep(wait_s)
+    return False
+
+
+def _serial_highs_baseline(T, lmps, cfs, n_serial):
+    """IPOPT-class serial baseline: the same 24-h wind+battery LP solved
+    one scenario at a time with scipy/HiGHS on the host CPU.
+
+    The LP is assembled INDEPENDENTLY of the Flowsheet lowering on
+    purpose: the obj_rel_err_vs_highs cross-check would be circular if
+    the baseline reused make_lp_data's extracted matrices.  Keep the
+    coefficients in sync with the flowsheet built in main().
+
+    Variable layout per scenario: x = [wind_elec, grid, batt_in,
+    batt_out, soc] each of length T.  Equalities: power balance,
+    SoC evolution (with soc0 = 0), periodic SoC.  The capacity-factor
+    and battery power limits are plain variable bounds in LP form.
+    Returns (seconds_per_solve, objectives)."""
+    from scipy.optimize import linprog
+    from scipy.sparse import lil_matrix
+
+    n = 5 * T
+    iw, ig, ibi, ibo, isoc = (slice(k * T, (k + 1) * T) for k in range(5))
+
+    A = lil_matrix((2 * T + 1, n))
+    b = np.zeros(2 * T + 1)
+    for t in range(T):
+        # power balance: wind - grid - batt_in = 0
+        A[t, iw.start + t] = 1.0
+        A[t, ig.start + t] = -1.0
+        A[t, ibi.start + t] = -1.0
+        # soc evolution: soc_t - soc_{t-1} - 0.95 batt_in + batt_out/0.95 = 0
+        A[T + t, isoc.start + t] = 1.0
+        if t > 0:
+            A[T + t, isoc.start + t - 1] = -1.0
+        A[T + t, ibi.start + t] = -0.95
+        A[T + t, ibo.start + t] = 1.0 / 0.95
+    A[2 * T, isoc.stop - 1] = 1.0  # periodic: soc[-1] = soc0 = 0
+    A = A.tocsr()
+
+    t0 = time.perf_counter()
+    objs = []
+    for i in range(n_serial):
+        c = np.zeros(n)
+        c[ig] = -lmps[i]
+        c[ibo] = -lmps[i]
+        bounds = (
+            [(0.0, cfs[i][t]) for t in range(T)]
+            + [(0.0, 1e6)] * T
+            + [(0.0, 300e3)] * T
+            + [(0.0, 300e3)] * T
+            + [(0.0, 4e6)] * T
+        )
+        res = linprog(c, A_eq=A, b_eq=b, bounds=bounds, method="highs")
+        assert res.status == 0, f"HiGHS baseline failed: {res.message}"
+        objs.append(-res.fun)
+    per_solve = (time.perf_counter() - t0) / n_serial
+    return per_solve, np.array(objs)
+
+
 def main():
+    backend_ok = _probe_backend()
+
     import jax
+
+    if not backend_ok:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        # Residual risk: a tunnel that drops in the seconds between the
+        # successful probe and this init HANGS rather than raising (a
+        # hang cannot be interrupted in-process); the probe immediately
+        # precedes this call to keep that window minimal.
+        backend = jax.devices()[0].platform
+    except Exception:
+        # probe passed but init errored — force CPU so the benchmark
+        # still reports a number (rc=0)
+        jax.config.update("jax_platforms", "cpu")
+        backend = jax.devices()[0].platform
 
     from dispatches_tpu import Flowsheet
     from dispatches_tpu.core.graph import tshift
     import jax.numpy as jnp
-    from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+    from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
 
     T = 24
     N_SCENARIOS = 366  # the annual-sweep batch (SURVEY.md §2.7)
@@ -60,7 +171,9 @@ def main():
         sense="max",
     )
 
-    solver = make_ipm_solver(nlp, IPMOptions(max_iter=60, tol=1e-8))
+    # The LP fast path: restarted PDHG in float32 — the TPU-native solver
+    # (f64 is software-emulated on TPU and ~90x slower; see pdlp.py).
+    solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-5, dtype="float32"))
 
     rng = np.random.default_rng(0)
     lmps = 0.02 + 0.015 * np.sin(
@@ -71,35 +184,59 @@ def main():
 
     params = nlp.default_params()
     in_axes = ({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},)
-    batched = {
-        "p": {"lmp": lmps, "wind_cap_cf": cfs},
-        "fixed": params["fixed"],
-    }
-
     vsolve = jax.jit(jax.vmap(solver, in_axes=in_axes))
-    single = jax.jit(solver)
 
-    # warm up compiles
-    p1 = {"p": {"lmp": lmps[0], "wind_cap_cf": cfs[0]}, "fixed": params["fixed"]}
-    single(p1).obj.block_until_ready()
-    vsolve(batched).obj.block_until_ready()
+    # The axon tunnel faults on very large single programs (observed
+    # with the f64 IPM: 366-wide vmap => "TPU device error", 32-wide
+    # fine; the smaller PDLP program runs full-width).  Try the full
+    # batch first and fall back to fixed-shape chunked dispatch.
+    def make_sweep(chunk):
+        def sweep(lmps, cfs):
+            objs = []
+            for s in range(0, len(lmps), chunk):
+                lc, cc = lmps[s : s + chunk], cfs[s : s + chunk]
+                if len(lc) < chunk:  # pad tail chunk to the compiled shape
+                    pad = chunk - len(lc)
+                    lc = np.concatenate([lc, np.repeat(lc[-1:], pad, 0)])
+                    cc = np.concatenate([cc, np.repeat(cc[-1:], pad, 0)])
+                r = vsolve(
+                    {"p": {"lmp": lc, "wind_cap_cf": cc}, "fixed": params["fixed"]}
+                )
+                objs.append(np.asarray(r.obj))
+            return np.concatenate(objs)[: len(lmps)]
 
-    # serial baseline: one scenario at a time (the reference's pattern)
+        return sweep
+
+    sweep = None
+    last_exc = None
+    for chunk in (N_SCENARIOS, 128, 32):
+        try:
+            sweep = make_sweep(chunk)
+            all_objs = sweep(lmps, cfs)  # also warms up the compile
+            break
+        except Exception as exc:  # tunnel faults on large programs
+            sweep = None
+            last_exc = exc
+    if sweep is None:
+        raise RuntimeError(
+            "all chunk sizes failed on this backend"
+        ) from last_exc
+
+    # IPOPT-class serial baseline on the host CPU (HiGHS per scenario,
+    # the reference's one-subprocess-per-solve pattern) + objective
+    # cross-check so the speedup compares equal work.
     n_serial = 16
-    t0 = time.perf_counter()
-    for i in range(n_serial):
-        pi = {
-            "p": {"lmp": lmps[i % N_SCENARIOS], "wind_cap_cf": cfs[i % N_SCENARIOS]},
-            "fixed": params["fixed"],
-        }
-        single(pi).obj.block_until_ready()
-    serial_per_solve = (time.perf_counter() - t0) / n_serial
+    serial_per_solve, ref_objs = _serial_highs_baseline(T, lmps, cfs, n_serial)
+    ipm_objs = all_objs[:n_serial]
+    rel_err = float(
+        np.max(np.abs(ipm_objs - ref_objs) / np.maximum(np.abs(ref_objs), 1.0))
+    )
 
     # batched throughput
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        vsolve(batched).obj.block_until_ready()
+        sweep(lmps, cfs)
     batched_per_sweep = (time.perf_counter() - t0) / reps
     solves_per_sec = N_SCENARIOS / batched_per_sweep
     speedup = serial_per_solve / (batched_per_sweep / N_SCENARIOS)
@@ -111,6 +248,9 @@ def main():
                 "value": round(solves_per_sec, 2),
                 "unit": "solves/s",
                 "vs_baseline": round(speedup, 2),
+                "backend": backend,
+                "baseline": "serial scipy-HiGHS per scenario (IPOPT-class)",
+                "obj_rel_err_vs_highs": round(rel_err, 8),
             }
         )
     )
