@@ -179,13 +179,32 @@ def make_ipm_solver(
     if getattr(opts, "autoscale", True) and n_x:
         p0 = scale_params if scale_params is not None else nlp.default_params()
         x0_ = jnp.asarray(nlp.x0)
+
+        def _row_maxes(fn, m_rows):
+            """max_j |J_ij| per row, J computed in column chunks — a
+            one-shot dense jacfwd is m x n and at annual horizons
+            (26k x 44k) that plus its jvp batch exceeds 100 GB RSS
+            (measured)."""
+            rows = np.zeros(m_rows)
+            chunk = max(1, min(n_x, int(2_000_000 // max(m_rows, 1)) or 1))
+            jac_cols = jax.jit(
+                lambda basis: jax.vmap(
+                    lambda v: jax.jvp(fn, (x0_,), (v,))[1]
+                )(basis)
+            )
+            for s in range(0, n_x, chunk):
+                k = min(chunk, n_x - s)
+                basis = np.zeros((k, n_x))  # only this chunk's rows of I
+                basis[np.arange(k), s + np.arange(k)] = 1.0
+                cols = np.asarray(jac_cols(jnp.asarray(basis)))
+                rows = np.maximum(rows, np.max(np.abs(cols), axis=0))
+            return rows
+
         if m_eq:
-            Je = np.asarray(jax.jacfwd(lambda x: nlp.eq(x, p0))(x0_))
-            rows = np.max(np.abs(Je), axis=1)
+            rows = _row_maxes(lambda x: nlp.eq(x, p0), m_eq)
             r_eq = 1.0 / np.maximum(1.0, np.where(np.isfinite(rows), rows, 1.0))
         if m_in:
-            Ji = np.asarray(jax.jacfwd(lambda x: nlp.ineq(x, p0))(x0_))
-            rows = np.max(np.abs(Ji), axis=1)
+            rows = _row_maxes(lambda x: nlp.ineq(x, p0), m_in)
             r_in = 1.0 / np.maximum(1.0, np.where(np.isfinite(rows), rows, 1.0))
         g0 = np.asarray(jax.grad(lambda x: nlp.objective(x, p0))(x0_))
         gmax = float(np.max(np.abs(g0))) if g0.size else 0.0

@@ -1,0 +1,98 @@
+"""Model-state checkpointing + warm-start caches.
+
+Capability counterpart of the reference stack's IDAES ``to_json`` /
+``from_json`` + ``StoreSpec`` machinery (SURVEY.md §5 checkpoint/resume:
+init-once-replicate of the USC flowsheet,
+``multiperiod_integrated_storage_usc.py:199-328``, and the on-disk
+``initialized_integrated_storage_usc.json`` consumed by ``main(
+load_from_file=...)``).  Here model state is a flat pytree of named
+arrays (a solution dict from ``CompiledNLP.unravel``, an ``IPMResult``,
+or any nested dict of arrays), serialized to ``.npz`` with a json
+manifest for structure — loadable into warm starts without rebuilding.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _flatten(tree, prefix="", out=None):
+    out = {} if out is None else out
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            _flatten(v, f"{prefix}{k}/", out)
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    tree: Dict = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def save_state(path, tree) -> Path:
+    """Serialize a (nested dict of) arrays — the ``to_json`` analog."""
+    path = Path(path)
+    flat = _flatten(tree)
+    np.savez(path.with_suffix(".npz"), **flat)
+    manifest = {k: list(v.shape) for k, v in flat.items()}
+    path.with_suffix(".json").write_text(json.dumps(manifest))
+    return path.with_suffix(".npz")
+
+
+def load_state(path):
+    """Load a state saved by :func:`save_state` — ``from_json`` analog."""
+    path = Path(path)
+    data = np.load(path.with_suffix(".npz"))
+    return _unflatten({k: data[k] for k in data.files})
+
+
+def save_solution(path, nlp, res) -> Path:
+    """Checkpoint a solve: unraveled physical solution + duals +
+    metadata (the reference's solved-flowsheet json snapshot)."""
+    sol = nlp.unravel(res.x)
+    tree = {
+        "solution": sol,
+        "duals": {
+            "lam": np.asarray(res.lam),
+            "z_l": np.asarray(res.z_l),
+            "z_u": np.asarray(res.z_u),
+        },
+        "meta": {
+            "obj": np.asarray(res.obj),
+            "kkt_error": np.asarray(res.kkt_error),
+            "x": np.asarray(res.x),
+        },
+    }
+    return save_state(path, tree)
+
+
+def warm_start_from(path, nlp) -> Optional[np.ndarray]:
+    """Physical x0 vector for ``solve(params, x0=...)`` from a solution
+    checkpoint, or None when the layout no longer matches (model
+    changed since the checkpoint — the init-once-replicate guard)."""
+    try:
+        tree = load_state(path)
+    except FileNotFoundError:
+        return None
+    sol = tree.get("solution", {})
+    parts = []
+    for name in nlp.free_names:
+        a, b, shape = nlp._slices[name]
+        if name not in sol or tuple(np.shape(sol[name])) != tuple(shape):
+            return None
+        parts.append(np.ravel(sol[name]))
+    if not parts:
+        return None
+    return np.concatenate(parts)

@@ -1,0 +1,28 @@
+"""Workflow layer: market-surrogate training pipeline, managed-data
+workflow, and double-loop result utilities.
+
+Capability counterpart of the reference's ``dispatches/workflow/``
+(SURVEY.md §2.4): ``SimulationData`` (sweep-output parsing),
+``TimeSeriesClustering`` (day-slice k-means — tslearn replaced by a
+vmapped JAX Lloyd iteration), ``TrainNNSurrogates`` (Keras MLPs replaced
+by flax/optax trained on the same chips), ``ManagedWorkflow`` /
+``DatasetFactory``, and the double-loop output readers.
+"""
+
+from dispatches_tpu.workflow.simulation_data import SimulationData
+from dispatches_tpu.workflow.clustering import TimeSeriesClustering
+from dispatches_tpu.workflow.surrogates import TrainNNSurrogates
+from dispatches_tpu.workflow.managed import (
+    Dataset,
+    DatasetFactory,
+    ManagedWorkflow,
+)
+
+__all__ = [
+    "SimulationData",
+    "TimeSeriesClustering",
+    "TrainNNSurrogates",
+    "ManagedWorkflow",
+    "Dataset",
+    "DatasetFactory",
+]

@@ -1,0 +1,227 @@
+"""Time-series k-means clustering of dispatch day-slices.
+
+Capability counterpart of the reference's
+``Time_Series_Clustering.py`` (:29-476): annual dispatch series are cut
+into 24-h days, all-zero / all-one capacity-factor days are filtered
+(:288-361), and the remaining days are clustered with Euclidean k-means
+(:366-386 — ``tslearn.TimeSeriesKMeans(metric='euclidean',
+random_state=42)``).  tslearn is replaced by a fully vectorized JAX
+Lloyd iteration (batched distance matmuls — MXU work — with k-means++
+seeding), and the trained model round-trips through the same
+json-with-centroids format (:388-433).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def slice_days(year: np.ndarray, time_length: int = 24, filter_opt: bool = True):
+    """Cut one annual series into day slices; with ``filter_opt``,
+    all-zero and all-one capacity-factor days are removed and counted
+    (reference :288-361 — the single filter rule shared by clustering
+    and label generation).  Returns (days, zero_count, full_count,
+    kept_day_indices)."""
+    days, kept, zero, full = [], [], 0, 0
+    day_num = len(year) // time_length
+    for d in range(day_num):
+        slc = year[d * time_length : (d + 1) * time_length]
+        if filter_opt:
+            s = float(np.sum(slc))
+            if s == 0.0:
+                zero += 1
+                continue
+            if s == float(time_length):
+                full += 1
+                continue
+        days.append(slc)
+        kept.append(d)
+    return days, zero, full, kept
+
+
+def kmeans_fit(
+    X: np.ndarray,
+    n_clusters: int,
+    seed: int = 42,
+    n_iter: int = 300,
+    tol: float = 1e-6,
+):
+    """Euclidean k-means on (N, D) data: k-means++ init + Lloyd
+    iterations under ``lax.while_loop``.  Returns (centers (k, D),
+    labels (N,), inertia)."""
+    X = jnp.asarray(X, jnp.float64)
+    n, d = X.shape
+    k = n_clusters
+    key = jax.random.PRNGKey(seed)
+
+    # k-means++ seeding
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n)
+    centers0 = jnp.zeros((k, d)).at[0].set(X[first])
+
+    def seed_body(i, carry):
+        centers, key = carry
+        d2 = jnp.min(
+            jnp.sum((X[:, None, :] - centers[None, :, :]) ** 2, -1)
+            + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0),
+            axis=1,
+        )
+        key, sub = jax.random.split(key)
+        probs = d2 / jnp.maximum(d2.sum(), 1e-30)
+        nxt = jax.random.choice(sub, n, p=probs)
+        return centers.at[i].set(X[nxt]), key
+
+    centers0, key = jax.lax.fori_loop(1, k, seed_body, (centers0, key))
+
+    def assign(centers):
+        d2 = (
+            jnp.sum(X * X, 1)[:, None]
+            - 2.0 * X @ centers.T
+            + jnp.sum(centers * centers, 1)[None, :]
+        )
+        return jnp.argmin(d2, 1), jnp.min(d2, 1)
+
+    def cond(state):
+        _, shift, it = state
+        return (shift > tol) & (it < n_iter)
+
+    def body(state):
+        centers, _, it = state
+        labels, _ = assign(centers)
+        onehot = jax.nn.one_hot(labels, k, dtype=X.dtype)  # (N, k)
+        counts = onehot.sum(0)
+        sums = onehot.T @ X
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+        )
+        shift = jnp.max(jnp.abs(new - centers))
+        return new, shift, it + 1
+
+    centers, _, _ = jax.lax.while_loop(cond, body, (centers0, jnp.inf, 0))
+    labels, d2 = assign(centers)
+    return np.asarray(centers), np.asarray(labels), float(jnp.sum(d2))
+
+
+class TimeSeriesClustering:
+    def __init__(self, num_clusters, simulation_data, filter_opt=True, metric="euclidean"):
+        self.simulation_data = simulation_data
+        self.num_clusters = num_clusters
+        self.filter_opt = filter_opt
+        self.metric = metric
+        self._time_length = 24
+
+    @property
+    def metric(self):
+        return self._metric
+
+    @metric.setter
+    def metric(self, value):
+        if value not in ("euclidean", "dtw"):
+            raise ValueError(
+                f"The metric must be one of 'euclidean' or 'dtw', but {value} is given"
+            )
+        self._metric = value
+
+    @property
+    def num_clusters(self):
+        return self._num_clusters
+
+    @num_clusters.setter
+    def num_clusters(self, value):
+        if not isinstance(value, int):
+            raise TypeError(
+                f"Number of clusters must be an integer, but {type(value)} is given"
+            )
+        self._num_clusters = value
+
+    # -- day slicing + filtering (reference :288-361) -----------------
+
+    def _slice_days(self, scaled_dispatch_dict):
+        days = []
+        for year in scaled_dispatch_dict.values():
+            d, _, _, _ = slice_days(year, self._time_length, self.filter_opt)
+            days.extend(d)
+        return days
+
+    def _transform_data_RE(self, wind_file=None):
+        """RE mode clusters (dispatch_day, wind_day) jointly
+        (reference ``_transform_data_RE``): feature = 48-vector."""
+        scaled = self.simulation_data._scale_data()
+        wind_data = self.simulation_data.read_wind_data(wind_file)
+        days = []
+        for year in scaled.values():
+            day_num = min(len(year) // self._time_length, len(wind_data))
+            kept, _, _, kept_ids = slice_days(
+                year[: day_num * self._time_length],
+                self._time_length,
+                self.filter_opt,
+            )
+            for d, i in zip(kept, kept_ids):
+                days.append(np.concatenate([d, wind_data[i]]))
+        return np.asarray(days)
+
+    def _transform_data(self, wind_file=None):
+        if self.simulation_data.case_type == "RE" and wind_file is not None:
+            return self._transform_data_RE(wind_file)
+        scaled = self.simulation_data._scale_data()
+        return np.asarray(self._slice_days(scaled))
+
+    # -- clustering (reference :366-386) ------------------------------
+
+    def clustering_data(self, wind_file=None):
+        if self.metric == "dtw":
+            raise NotImplementedError(
+                "soft-DTW metric is not implemented; use 'euclidean' "
+                "(the reference's tests and trained artifacts use euclidean)"
+            )
+        train = self._transform_data(wind_file)
+        centers, labels, inertia = kmeans_fit(
+            train, self.num_clusters, seed=42
+        )
+        return {
+            "n_clusters": self.num_clusters,
+            "cluster_centers_": centers,
+            "labels_": labels,
+            "inertia_": inertia,
+            "metric": self.metric,
+        }
+
+    # -- model (de)serialization (reference :388-433) -----------------
+
+    def save_clustering_model(self, clustering_model, fpath):
+        out = {
+            "n_clusters": int(clustering_model["n_clusters"]),
+            "metric": clustering_model["metric"],
+            "model_params": {
+                "cluster_centers_": np.asarray(
+                    clustering_model["cluster_centers_"]
+                ).tolist()
+            },
+        }
+        with open(fpath, "w") as f:
+            json.dump(out, f)
+        return fpath
+
+    @staticmethod
+    def load_clustering_model(fpath):
+        with open(fpath) as f:
+            raw = json.load(f)
+        centers = np.asarray(raw["model_params"]["cluster_centers_"], dtype=float)
+        # tslearn stores (k, T, 1); squeeze any trailing singleton
+        if centers.ndim == 3 and centers.shape[-1] == 1:
+            centers = centers[:, :, 0]
+        return {
+            "n_clusters": int(raw.get("n_clusters", len(centers))),
+            "cluster_centers_": centers,
+            "metric": raw.get("metric", "euclidean"),
+        }
+
+    def get_cluster_centers(self, result_path):
+        model = self.load_clustering_model(result_path)
+        centers = model["cluster_centers_"]
+        return {i: centers[i] for i in range(len(centers))}

@@ -1,0 +1,228 @@
+"""NN market surrogates: revenue + dispatch-frequency MLPs.
+
+Capability counterpart of the reference's ``Train_NN_Surrogates.py``
+(:31-564): labels are either swept-run revenues (:444-484) or per-run
+cluster-frequency vectors ``[ws0, f_1..f_k, ws1]`` built by predicting
+each day-slice against the trained k-means centroids (:208-300); the
+surrogate is an MLP with sigmoid hidden layers trained with Adam on MSE
+for 500 epochs on standardized inputs/outputs (:356-401).  Keras is
+replaced by a flax ``nnx``-free explicit-parameter MLP trained with
+optax under ``jit`` — same architecture, same scaling-metadata json
+(xm/xstd/xmin/xmax + label mean/std, :516-564).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dispatches_tpu.workflow.clustering import TimeSeriesClustering
+
+
+def _init_mlp(sizes: Sequence[int], key) -> List[Dict[str, jnp.ndarray]]:
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        fan_in, fan_out = sizes[i], sizes[i + 1]
+        # Glorot-uniform (keras Dense default)
+        lim = np.sqrt(6.0 / (fan_in + fan_out))
+        W = jax.random.uniform(sub, (fan_in, fan_out), minval=-lim, maxval=lim)
+        params.append({"W": W, "b": jnp.zeros((fan_out,))})
+    return params
+
+
+def mlp_apply(params, x):
+    """Sigmoid hidden layers, linear output (reference :394-399)."""
+    h = x
+    for layer in params[:-1]:
+        h = jax.nn.sigmoid(h @ layer["W"] + layer["b"])
+    last = params[-1]
+    return h @ last["W"] + last["b"]
+
+
+def _train_mlp(x, y, sizes, epochs=500, seed=0, learning_rate=1e-3):
+    params = _init_mlp(sizes, jax.random.PRNGKey(seed))
+    tx = optax.adam(learning_rate)
+    opt_state = tx.init(params)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            pred = mlp_apply(p, x)
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = jnp.inf
+    for _ in range(epochs):
+        params, opt_state, loss = step(params, opt_state)
+    return params, float(loss)
+
+
+def _train_test_split(x, y, test_size, seed):
+    n = len(x)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_test = max(1, int(round(n * test_size))) if n > 1 else 0
+    test, train = perm[:n_test], perm[n_test:]
+    return x[train], x[test], y[train], y[test]
+
+
+class TrainNNSurrogates:
+    def __init__(self, simulation_data, data_file, filter_opt=True):
+        self.simulation_data = simulation_data
+        self.data_file = str(data_file)
+        self.filter_opt = filter_opt
+        self._time_length = 24
+        self.model_type = None
+        self._model_params = None
+        self.clustering_model = None
+        self.num_clusters = None
+
+    # -- clustering-model consumption (reference :160-205) ------------
+
+    def _read_clustering_model(self, clustering_model_path):
+        model = TimeSeriesClustering.load_clustering_model(clustering_model_path)
+        self.clustering_model = model
+        self.num_clusters = model["n_clusters"]
+        return model
+
+    def _predict_clusters(self, days: np.ndarray) -> np.ndarray:
+        centers = self.clustering_model["cluster_centers_"]
+        d2 = (
+            np.sum(days * days, 1)[:, None]
+            - 2.0 * days @ centers.T
+            + np.sum(centers * centers, 1)[None, :]
+        )
+        return np.argmin(d2, axis=1)
+
+    # -- label generation (reference :208-300) ------------------------
+
+    def _generate_label_data(self) -> Dict[int, List[float]]:
+        from dispatches_tpu.workflow.clustering import slice_days
+
+        scaled = self.simulation_data._scale_data()
+        out = {}
+        for idx, year in scaled.items():
+            day_num = len(year) // self._time_length
+            days, zero_day, full_day, _ = slice_days(
+                year, self._time_length, self.filter_opt
+            )
+            if self.filter_opt:
+                ws = [zero_day / day_num]
+                counts = np.zeros(self.num_clusters)
+                if days:
+                    labels = self._predict_clusters(np.asarray(days))
+                    for j in labels:
+                        counts[j] += 1
+                ws.extend((counts / day_num).tolist())
+                ws.append(full_day / day_num)
+            else:
+                counts = np.zeros(self.num_clusters)
+                if days:
+                    labels = self._predict_clusters(np.asarray(days))
+                    for j in labels:
+                        counts[j] += 1
+                ws = (counts / day_num).tolist()
+            out[idx] = ws
+        return out
+
+    def _transform_dict_to_array(self):
+        if self.model_type == "frequency":
+            y_dict = self._generate_label_data()
+        else:
+            y_dict = self.simulation_data.read_rev_data(self.data_file)
+        idxs = list(self.simulation_data._dispatch_dict.keys())
+        x = np.array([self.simulation_data._input_data_dict[i] for i in idxs])
+        y = np.array([y_dict[i] for i in idxs])
+        if y.ndim == 1:
+            y = y[:, None]
+        return x, y
+
+    # -- training (reference :356-484) --------------------------------
+
+    def _train(self, NN_size, split_seed, epochs):
+        x, y = self._transform_dict_to_array()
+        x_train, x_test, y_train, y_test = _train_test_split(
+            x, y, test_size=0.2, seed=split_seed
+        )
+        xm, xstd = np.mean(x_train, 0), np.std(x_train, 0)
+        ym, ystd = np.mean(y_train, 0), np.std(y_train, 0)
+        xstd = np.where(xstd == 0, 1.0, xstd)
+        ystd = np.where(ystd == 0, 1.0, ystd)
+        xs, ys = (x_train - xm) / xstd, (y_train - ym) / ystd
+
+        params, train_loss = _train_mlp(xs, ys, NN_size, epochs=epochs)
+
+        # R2 on the held-out split (reference :421-431, :497-505)
+        R2 = None
+        if len(x_test):
+            pred = np.asarray(mlp_apply(params, (x_test - xm) / xstd)) * ystd + ym
+            ss_tot = np.sum((y_test - ym) ** 2, axis=0)
+            ss_res = np.sum((y_test - pred) ** 2, axis=0)
+            R2 = (1.0 - ss_res / np.where(ss_tot == 0, 1.0, ss_tot)).tolist()
+
+        self._model_params = {
+            "xm_inputs": xm.tolist(),
+            "xstd_inputs": xstd.tolist(),
+            "xmin": np.min(xs, 0).tolist(),
+            "xmax": np.max(xs, 0).tolist(),
+            "y_mean": ym.tolist(),
+            "y_std": ystd.tolist(),
+            "R2": R2,
+            "train_loss": train_loss,
+        }
+        return params
+
+    def train_NN_frequency(self, NN_size, epochs=500):
+        self.model_type = "frequency"
+        self._read_clustering_model(self.data_file)
+        return self._train(NN_size, split_seed=0, epochs=epochs)
+
+    def train_NN_revenue(self, NN_size, epochs=500):
+        self.model_type = "revenue"
+        return self._train(NN_size, split_seed=42, epochs=epochs)
+
+    # -- persistence (reference :516-564) -----------------------------
+
+    def save_model(self, params, NN_model_path, NN_param_path):
+        """Checkpoint = npz of layer weights (the SavedModel analog) +
+        scaling-metadata json."""
+        flat = {}
+        for i, layer in enumerate(params):
+            flat[f"W{i}"] = np.asarray(layer["W"])
+            flat[f"b{i}"] = np.asarray(layer["b"])
+        np.savez(NN_model_path, **flat)
+        with open(NN_param_path, "w") as f:
+            json.dump(self._model_params, f)
+
+    @staticmethod
+    def load_model(NN_model_path, NN_param_path=None):
+        data = np.load(NN_model_path)
+        n_layers = sum(1 for k in data.files if k.startswith("W"))
+        params = [
+            {"W": jnp.asarray(data[f"W{i}"]), "b": jnp.asarray(data[f"b{i}"])}
+            for i in range(n_layers)
+        ]
+        scaling = None
+        if NN_param_path is not None:
+            with open(NN_param_path) as f:
+                scaling = json.load(f)
+        return params, scaling
+
+    @staticmethod
+    def predict(params, scaling, x):
+        x = (np.asarray(x) - np.asarray(scaling["xm_inputs"])) / np.asarray(
+            scaling["xstd_inputs"]
+        )
+        out = np.asarray(mlp_apply(params, jnp.asarray(x)))
+        return out * np.asarray(scaling["y_std"]) + np.asarray(scaling["y_mean"])

@@ -1,0 +1,66 @@
+"""Checkpoint/warm-start layer: save/load round-trip and warm-started
+resolves (the reference's to_json/from_json init-once-replicate,
+SURVEY.md §5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.solvers import IPMOptions, solve_nlp
+from dispatches_tpu.utils.checkpoint import (
+    load_state,
+    save_solution,
+    save_state,
+    warm_start_from,
+)
+
+
+def _model(T=12):
+    fs = Flowsheet(horizon=T)
+    fs.add_var("charge", lb=0, ub=2.0)
+    fs.add_var("discharge", lb=0, ub=2.0)
+    fs.add_var("soc", lb=0, ub=8.0)
+    fs.add_param("price", np.sin(np.arange(T)) * 20 + 30)
+    fs.add_eq(
+        "soc_evolution",
+        lambda v, p: v["soc"] - tshift(v["soc"], jnp.asarray(0.0))
+        - 0.9 * v["charge"] + v["discharge"] / 0.9,
+    )
+    return fs.compile(
+        objective=lambda v, p: jnp.sum(p["price"] * (v["discharge"] - v["charge"])),
+        sense="max",
+    )
+
+
+def test_state_roundtrip(tmp_path):
+    tree = {
+        "a": np.arange(5.0),
+        "nested": {"b": np.ones((2, 3)), "c": np.asarray(2.5)},
+    }
+    p = save_state(tmp_path / "ckpt", tree)
+    assert p.exists()
+    loaded = load_state(tmp_path / "ckpt")
+    np.testing.assert_array_equal(loaded["a"], tree["a"])
+    np.testing.assert_array_equal(loaded["nested"]["b"], tree["nested"]["b"])
+    assert float(loaded["nested"]["c"]) == 2.5
+
+
+def test_solution_checkpoint_and_warm_start(tmp_path):
+    nlp = _model()
+    res = solve_nlp(nlp, options=IPMOptions(max_iter=100))
+    assert bool(res.converged)
+    save_solution(tmp_path / "sol", nlp, res)
+
+    x0 = warm_start_from(tmp_path / "sol", nlp)
+    assert x0 is not None and x0.shape == (nlp.n,)
+    # warm-started resolve reaches the same objective
+    res2 = solve_nlp(nlp, x0=x0, options=IPMOptions(max_iter=100))
+    assert float(res2.obj) == pytest.approx(float(res.obj), rel=1e-8)
+
+    # layout mismatch -> None (model changed since checkpoint)
+    other = _model(T=10)
+    assert warm_start_from(tmp_path / "sol", other) is None
+    # missing file -> None
+    assert warm_start_from(tmp_path / "nope", nlp) is None

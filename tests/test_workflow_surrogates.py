@@ -1,0 +1,176 @@
+"""Surrogate-training workflow tests mirroring the reference's
+``train_market_surrogates/dynamic/tests`` (SimulationData parsing,
+day-slice clustering, NN label generation/training) on the reference's
+own vendored fixtures, plus the managed-workflow layer."""
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.workflow import (
+    Dataset,
+    DatasetFactory,
+    ManagedWorkflow,
+    SimulationData,
+    TimeSeriesClustering,
+    TrainNNSurrogates,
+)
+from dispatches_tpu.workflow.clustering import kmeans_fit
+
+DATA = Path(
+    "/root/reference/dispatches/workflow/train_market_surrogates/dynamic/tests/data"
+)
+_HAS_DATA = DATA.is_dir()
+pytestmark = pytest.mark.skipif(
+    not _HAS_DATA, reason="reference fixtures not mounted"
+)
+
+
+@pytest.fixture
+def sd_ne():
+    return SimulationData(
+        DATA / "simdatatest.csv", DATA / "input_data_test_NE.h5", 3, "NE"
+    )
+
+
+def test_simulation_data_validation():
+    with pytest.raises(TypeError):
+        SimulationData(
+            DATA / "simdatatest.csv", DATA / "input_data_test_NE.h5", "3", "NE"
+        )
+    with pytest.raises(ValueError):
+        SimulationData(
+            DATA / "simdatatest.csv", DATA / "input_data_test_NE.h5", 0, "NE"
+        )
+    with pytest.raises(ValueError):
+        SimulationData(
+            DATA / "simdatatest.csv", DATA / "input_data_test_NE.h5", 3, "XX"
+        )
+
+
+def test_read_data_to_array(sd_ne):
+    # reference test_read_data_to_array: 3 constant series 200/340/400
+    arr, index = sd_ne._read_data_to_array()
+    np.testing.assert_array_equal(
+        arr,
+        np.array(
+            [np.ones(366 * 24) * 200, np.ones(366 * 24) * 340, np.ones(366 * 24) * 400]
+        ),
+    )
+    assert index == [0, 1, 2]
+
+
+def test_scale_data_cases(sd_ne):
+    # NE scaling: (d - pmin) / (400 - pmin) -> 0 / 0.25 / 1
+    scaled = sd_ne._scale_data()
+    assert np.unique(scaled[0]) == pytest.approx([0.0])
+    assert np.unique(scaled[1]) == pytest.approx([0.25])
+    assert np.unique(scaled[2]) == pytest.approx([1.0])
+    # RE scaling: d / 847
+    sd_re = SimulationData(
+        DATA / "simdatatest.csv", DATA / "input_data_test_RE.h5", 3, "RE"
+    )
+    assert np.unique(sd_re._scale_data()[0]) == pytest.approx([200 / 847.0])
+    # FE scaling: (d - 284) / (436 - 284)
+    sd_fe = SimulationData(
+        DATA / "simdatatest.csv", DATA / "input_data_test_FE.h5", 3, "FE"
+    )
+    assert np.unique(sd_fe._scale_data()[1]) == pytest.approx([(340 - 284) / 152.0])
+
+
+def test_read_rev_data(sd_ne):
+    rev = sd_ne.read_rev_data(DATA / "revdatatest.csv")
+    assert rev == {0: 10000, 1: 20000, 2: 30000}
+
+
+def test_transform_data_filter(sd_ne):
+    # reference test_transform_data_NE: of 3x366 days, the all-0 and
+    # all-1 years are filtered, leaving the 0.25-cf year's 366 days
+    tsc = TimeSeriesClustering(1, sd_ne, filter_opt=True)
+    train = tsc._transform_data()
+    assert train.shape == (366, 24)
+    tsc_nf = TimeSeriesClustering(1, sd_ne, filter_opt=False)
+    assert tsc_nf._transform_data().shape == (3 * 366, 24)
+
+
+def test_get_cluster_centers(sd_ne):
+    tsc = TimeSeriesClustering(1, sd_ne)
+    centers = tsc.get_cluster_centers(DATA / "sample_clustering_model.json")
+    np.testing.assert_allclose(centers[0], np.full(24, 0.25))
+
+
+def test_kmeans_recovers_separated_clusters():
+    rng = np.random.default_rng(0)
+    a = rng.normal(0.2, 0.01, (40, 24))
+    b = rng.normal(0.8, 0.01, (40, 24))
+    X = np.concatenate([a, b])
+    centers, labels, inertia = kmeans_fit(X, 2, seed=42)
+    assert sorted(np.round(centers.mean(axis=1), 1)) == [0.2, 0.8]
+    # the two blocks get distinct labels
+    assert len(set(labels[:40])) == 1 and len(set(labels[40:])) == 1
+    assert labels[0] != labels[-1]
+
+
+def test_clustering_roundtrip(tmp_path, sd_ne):
+    tsc = TimeSeriesClustering(2, sd_ne, filter_opt=False)
+    model = tsc.clustering_data()
+    path = tmp_path / "model.json"
+    tsc.save_clustering_model(model, path)
+    loaded = TimeSeriesClustering.load_clustering_model(path)
+    assert loaded["n_clusters"] == 2
+    np.testing.assert_allclose(
+        loaded["cluster_centers_"], model["cluster_centers_"], rtol=1e-12
+    )
+
+
+def test_generate_label_data(sd_ne):
+    # reference test_generate_label_data: {0:[1,0,0],1:[0,1,0],2:[0,0,1]}
+    tr = TrainNNSurrogates(sd_ne, DATA / "sample_clustering_model.json")
+    tr._read_clustering_model(tr.data_file)
+    assert tr.num_clusters == 1
+    labels = tr._generate_label_data()
+    assert labels == {0: [1.0, 0.0, 0.0], 1: [0.0, 1.0, 0.0], 2: [0.0, 0.0, 1.0]}
+
+
+def test_train_frequency_surrogate(tmp_path, sd_ne):
+    tr = TrainNNSurrogates(sd_ne, DATA / "sample_clustering_model.json")
+    params = tr.train_NN_frequency([4, 16, 3], epochs=120)
+    assert tr._model_params is not None
+    # save/load/predict round-trip
+    mpath, ppath = tmp_path / "m.npz", tmp_path / "p.json"
+    tr.save_model(params, mpath, ppath)
+    loaded, scaling = TrainNNSurrogates.load_model(mpath, ppath)
+    x = np.array([sd_ne._input_data_dict[0]])
+    pred = TrainNNSurrogates.predict(loaded, scaling, x)
+    assert pred.shape == (1, 3)
+    assert np.all(np.isfinite(pred))
+
+
+def test_train_revenue_surrogate(sd_ne):
+    tr = TrainNNSurrogates(sd_ne, DATA / "revdatatest.csv")
+    params = tr.train_NN_revenue([4, 16, 1], epochs=300)
+    # 3 samples, split leaves 2 train/1 test; just require finite fit
+    # and a sane training loss (standardized targets)
+    assert tr._model_params["train_loss"] < 1.0
+    x = np.array([sd_ne._input_data_dict[i] for i in [0, 1, 2]])
+    pred = TrainNNSurrogates.predict(params, tr._model_params, x)
+    assert np.all(np.isfinite(pred))
+
+
+def test_managed_workflow(tmp_path):
+    wf = ManagedWorkflow("test-wf", "ws")
+    assert wf.name == "test-wf" and wf.workspace_name == "ws"
+    assert wf.get_dataset("null") is None
+    ds = wf.get_dataset("rts-gmlc", path=str(tmp_path))
+    assert isinstance(ds, Dataset)
+    assert ds.meta["directory"] == tmp_path
+    # memoized per type
+    assert wf.get_dataset("rts-gmlc") is ds
+    with pytest.raises(KeyError):
+        DatasetFactory("unknown-type")
+    with pytest.raises(FileNotFoundError):
+        DatasetFactory("rts-gmlc").create(path=str(tmp_path / "missing"))
+    assert "directory" in str(ds)
