@@ -199,8 +199,11 @@ class MultiPeriodNuclear:
         """Advance the realized initial holdup (reference :217-237)."""
         fs = blk.m.fs
         tank = blk.m.units["h2_tank"]
-        fs.var_specs[tank.v("tank_holdup_previous")].fixed_value = np.asarray(
-            round(float(implemented_tank_holdup[-1]))
+        # fs.fix keeps the float64 dtype/shape contract (a raw int
+        # fixed_value would retrace the jitted kernels)
+        fs.fix(
+            tank.v("tank_holdup_previous"),
+            float(round(float(implemented_tank_holdup[-1]))),
         )
 
     @staticmethod

@@ -1,0 +1,293 @@
+"""Scenario-stacked NLP: the two-stage stochastic program builder.
+
+The reference's Bidder builds one Pyomo model with ``fs`` indexed by
+scenario and shared first-stage variables (SURVEY.md §2.8; the
+``day_ahead_model.fs`` scenario index visible in
+``test_multiperiod_wind_battery_doubleloop.py:167-168``).  Here the
+same structure is built over a compiled per-scenario NLP:
+
+    X = [x_1, ..., x_S, e]      e = first-stage schedule, shape (T,)
+
+* per-scenario residuals are evaluated with ``vmap`` over the scenario
+  slab (one trace, S lanes — scenario parallelism per SURVEY.md §2.7);
+* non-anticipativity is BY CONSTRUCTION: one shared ``e`` with hard
+  coupling rows ``P_s(x_s) - e = 0`` for every scenario (the delivered
+  profile cannot depend on which price scenario materializes);
+* the objective is the probability-weighted sum of scenario objectives
+  plus an optional first-stage term.
+
+The result implements the ``CompiledNLP`` surface consumed by
+``make_ipm_solver`` (objective/eq/ineq, x0/lb/ub/var_scale,
+``default_params``/``unravel``), so the stacked program solves on the
+same kernels as everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _FS:
+    """Minimal fs surface for solver-side introspection."""
+
+    def __init__(self, horizon):
+        self.horizon = horizon
+
+
+class StackedScenarioNLP:
+    """Stack ``n_scenarios`` copies of a compiled NLP with a shared
+    first-stage profile.
+
+    Args:
+        nlp: the per-scenario CompiledNLP (one flowsheet over horizon T)
+        n_scenarios: S
+        scenario_param_keys: params batched per scenario (e.g.
+            ``["energy_price"]``); everything else is shared
+        first_stage_expr: ``fn(v, p) -> (T,)`` evaluated per scenario —
+            the profile the coupling acts on (delivered power)
+        coupling: "first_stage" ties the profile hard across scenarios
+            through a shared schedule variable (SelfScheduler
+            non-anticipativity); "monotone" instead enforces
+            incentive-compatible bid-curve consistency — whenever
+            scenario s sees a higher price than s', its dispatch must
+            be at least as large: (pi_s - pi_s')(P_s - P_s') >= 0 for
+            all pairs, per hour (the idaes Bidder's curve
+            non-anticipativity, written order-free so the constraint
+            structure is price-data independent and compiles once)
+        price_key: the scenario param holding the per-hour prices
+            (required for "monotone")
+        first_stage_bounds: (lb, ub) for the shared schedule ``e``
+        weights: scenario probabilities (default uniform)
+        first_stage_obj: optional ``fn(e, p) -> scalar`` added to the
+            weighted scenario objectives (DA settlement terms)
+    """
+
+    def __init__(
+        self,
+        nlp,
+        n_scenarios: int,
+        scenario_param_keys: Sequence[str],
+        first_stage_expr: Callable,
+        coupling: str = "first_stage",
+        price_key: Optional[str] = None,
+        first_stage_bounds=(0.0, np.inf),
+        weights: Optional[Sequence[float]] = None,
+        first_stage_obj: Optional[Callable] = None,
+        first_stage_scale: float = 1.0,
+    ):
+        if coupling not in ("first_stage", "monotone"):
+            raise ValueError("coupling must be 'first_stage' or 'monotone'")
+        if coupling == "monotone" and price_key is None:
+            raise ValueError("coupling='monotone' requires price_key")
+        self.base = nlp
+        self.S = int(n_scenarios)
+        self.T = int(nlp.fs.horizon)
+        self.fs = _FS(self.T)
+        self.sense = nlp.sense
+        self.coupling = coupling
+        self._price_key = price_key
+        self._sp_keys = list(scenario_param_keys)
+        self._fs_expr = first_stage_expr
+        self._fs_obj = first_stage_obj
+        w = (
+            np.full(self.S, 1.0 / self.S)
+            if weights is None
+            else np.asarray(weights, float)
+        )
+        if len(w) != self.S or abs(w.sum() - 1.0) > 1e-9:
+            raise ValueError("weights must have length S and sum to 1")
+        self._w = jnp.asarray(w)
+
+        n1 = nlp.n
+        self._n1 = n1
+        self._has_e = coupling == "first_stage"
+        n_e = self.T if self._has_e else 0
+        self.n = self.S * n1 + n_e
+
+        # bounds/inits/scales: scenario slabs then the first stage
+        self._e_scale = first_stage_scale
+        lb_e = np.broadcast_to(np.asarray(first_stage_bounds[0], float), (n_e,))
+        ub_e = np.broadcast_to(np.asarray(first_stage_bounds[1], float), (n_e,))
+        self.var_scale = np.concatenate(
+            [np.tile(np.asarray(nlp.var_scale), self.S),
+             np.full(n_e, first_stage_scale)]
+        )
+        self.lb = np.concatenate(
+            [np.tile(np.asarray(nlp.lb), self.S), lb_e / first_stage_scale]
+        )
+        self.ub = np.concatenate(
+            [np.tile(np.asarray(nlp.ub), self.S), ub_e / first_stage_scale]
+        )
+
+        # x0: per-scenario inits + first stage from the base expression
+        p0 = nlp.default_params()
+        v0 = nlp._vals(jnp.asarray(nlp.x0), p0)
+        from dispatches_tpu.core.graph import Vals
+
+        e0 = np.asarray(first_stage_expr(v0, Vals(p0["p"])))[:n_e]
+        self.x0 = np.concatenate(
+            [np.tile(np.asarray(nlp.x0), self.S), e0 / first_stage_scale]
+        )
+
+        n_pairs = self.S * (self.S - 1) // 2
+        self.m_eq = self.S * nlp.m_eq + (self.S * self.T if self._has_e else 0)
+        self.m_ineq = self.S * nlp.m_ineq + (
+            0 if self._has_e else n_pairs * self.T
+        )
+        self._pairs = np.array(
+            [(i, j) for i in range(self.S) for j in range(i + 1, self.S)],
+            dtype=np.int64,
+        ).reshape(n_pairs, 2)
+
+        # named slices for unravel: "s{k}.var" + "first_stage"
+        self.free_names: List[str] = []
+        self._slices: Dict = {}
+        for s in range(self.S):
+            off = s * n1
+            for name in nlp.free_names:
+                a, b, shape = nlp._slices[name]
+                key = f"s{s}.{name}"
+                self.free_names.append(key)
+                self._slices[key] = (off + a, off + b, shape)
+        if self._has_e:
+            self.free_names.append("first_stage")
+            self._slices["first_stage"] = (
+                self.S * n1, self.S * n1 + self.T, (self.T,)
+            )
+        self.fixed_names = list(nlp.fixed_names)
+
+        # eq/ineq slice maps (per-scenario blocks + coupling)
+        self.eq_slices = {}
+        o = 0
+        for s in range(self.S):
+            for cname, (a, b) in nlp.eq_slices.items():
+                self.eq_slices[f"s{s}.{cname}"] = (o + a, o + b)
+            o += nlp.m_eq
+        if self._has_e:
+            for s in range(self.S):
+                self.eq_slices[f"s{s}.non_anticipativity"] = (o, o + self.T)
+                o += self.T
+        self.ineq_slices = {}
+        o = 0
+        for s in range(self.S):
+            for cname, (a, b) in nlp.ineq_slices.items():
+                self.ineq_slices[f"s{s}.{cname}"] = (o + a, o + b)
+            o += nlp.m_ineq
+        if not self._has_e and n_pairs:
+            self.ineq_slices["bid_monotonicity"] = (o, o + n_pairs * self.T)
+
+    # -- params -------------------------------------------------------
+
+    def default_params(self):
+        base = self.base.default_params()
+        p = dict(base["p"])
+        for k in self._sp_keys:
+            p[k] = np.tile(np.asarray(p[k])[None, ...], (self.S,) + (1,) * np.ndim(p[k]))
+        return {"p": p, "fixed": base["fixed"]}
+
+    def _scenario_params(self, params, s):
+        p = dict(params["p"])
+        for k in self._sp_keys:
+            # jnp indexing: s is a tracer under the vmapped evaluation
+            p[k] = jnp.asarray(params["p"][k])[s]
+        return {"p": p, "fixed": params["fixed"]}
+
+    def _split(self, x):
+        xs = x[: self.S * self._n1].reshape(self.S, self._n1)
+        e = x[self.S * self._n1 :]  # empty in "monotone" mode
+        return xs, e
+
+    def _profiles(self, xs, params):
+        """(S, T) coupled profile per scenario."""
+        from dispatches_tpu.core.graph import Vals
+
+        def one(s, x_s):
+            p_s = self._scenario_params(params, s)
+            v = self.base._vals(x_s, p_s)
+            return self._fs_expr(v, Vals(p_s["p"]))
+
+        return jax.vmap(one)(jnp.arange(self.S), xs)
+
+    def _per_scenario(self, fn, x, params):
+        xs, _ = self._split(x)
+
+        def one(s, x_s):
+            return fn(x_s, self._scenario_params(params, s))
+
+        return jax.vmap(one)(jnp.arange(self.S), xs)
+
+    # -- CompiledNLP surface ------------------------------------------
+
+    def objective(self, x, params):
+        xs, e = self._split(x)
+        objs = self._per_scenario(self.base.objective, x, params)
+        total = jnp.sum(self._w * objs)
+        if self._fs_obj is not None:
+            from dispatches_tpu.core.graph import Vals
+
+            fs_term = self._fs_obj(e * self._e_scale, Vals(params["p"]))
+            # base.objective is in minimization form; user fs_obj is in
+            # the USER's sense
+            total = total + (-fs_term if self.sense == "max" else fs_term)
+        return total
+
+    def user_objective(self, x, params):
+        val = self.objective(x, params)
+        return -val if self.sense == "max" else val
+
+    def eq(self, x, params):
+        xs, e = self._split(x)
+        blocks = self._per_scenario(self.base.eq, x, params)  # (S, m_eq)
+        if not self._has_e:
+            return blocks.reshape(-1)
+        prof = self._profiles(xs, params)  # (S, T)
+        na = (prof - (e * self._e_scale)[None, :]) * (1.0 / self._e_scale)
+        return jnp.concatenate([blocks.reshape(-1), na.reshape(-1)])
+
+    def ineq(self, x, params):
+        xs, _ = self._split(x)
+        blocks = self._per_scenario(self.base.ineq, x, params).reshape(-1)
+        if self._has_e or not len(self._pairs):
+            return blocks
+        # incentive compatibility: (pi_i - pi_j)(P_i - P_j) >= 0
+        prof = self._profiles(xs, params)  # (S, T)
+        prices = params["p"][self._price_key]  # (S, T)
+        i, j = self._pairs[:, 0], self._pairs[:, 1]
+        dpi = (prices[i] - prices[j]) * (1.0 / jnp.maximum(
+            jnp.max(jnp.abs(prices)), 1.0
+        ))
+        dP = (prof[i] - prof[j]) * (1.0 / self._e_scale)
+        mono = -(dpi * dP)  # <= 0
+        return jnp.concatenate([blocks, mono.reshape(-1)])
+
+    # -- helpers ------------------------------------------------------
+
+    def unravel(self, x):
+        x = np.asarray(x)
+        out = {}
+        for name, (a, b, shape) in self._slices.items():
+            out[name] = (x[a:b] * self.var_scale[a:b]).reshape(shape)
+        return out
+
+    def scenario_solution(self, x, s: int):
+        """Per-scenario solution dict in the base NLP's naming."""
+        xs, _ = self._split(np.asarray(x))
+        return self.base.unravel(xs[s])
+
+    def first_stage(self, x):
+        if not self._has_e:
+            raise ValueError(
+                "no shared schedule variable in coupling='monotone' mode"
+            )
+        _, e = self._split(np.asarray(x))
+        return np.asarray(e) * self._e_scale
+
+    def scenario_profiles(self, x, params=None):
+        """(S, T) coupled profiles at a solution (host-side)."""
+        params = self.default_params() if params is None else params
+        xs, _ = self._split(jnp.asarray(x))
+        return np.asarray(self._profiles(xs, params))

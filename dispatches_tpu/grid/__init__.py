@@ -17,6 +17,16 @@ from dispatches_tpu.grid.model_data import (
 from dispatches_tpu.grid.forecaster import Backcaster, PerfectForecaster
 from dispatches_tpu.grid.tracker import Tracker
 from dispatches_tpu.grid.bidder import Bidder, SelfScheduler
+from dispatches_tpu.grid.coordinator import (
+    DoubleLoopCoordinator,
+    convert_marginal_costs_to_actual_costs,
+)
+from dispatches_tpu.grid.market import (
+    MarketCase,
+    MarketSimulator,
+    load_rts_gmlc_case,
+    solve_unit_commitment,
+)
 
 __all__ = [
     "RenewableGeneratorModelData",
@@ -26,4 +36,10 @@ __all__ = [
     "Tracker",
     "Bidder",
     "SelfScheduler",
+    "DoubleLoopCoordinator",
+    "convert_marginal_costs_to_actual_costs",
+    "MarketCase",
+    "MarketSimulator",
+    "load_rts_gmlc_case",
+    "solve_unit_commitment",
 ]

@@ -1,19 +1,22 @@
-"""Bidder / SelfScheduler: scenario-based bid optimization.
+"""Bidder / SelfScheduler: two-stage stochastic bid optimization.
 
 Capability counterpart of ``idaes.apps.grid_integration.bidder`` as
 consumed by the reference (``run_double_loop.py:241-258``,
 ``test_multiperiod_wind_battery_doubleloop.py:152-252``): optimize the
 operation model against forecast price scenarios and emit either a
-self-schedule (per-hour p_max energies) or thermal-style bid curves
-(per-hour (power, cost) pairs).
+self-schedule (per-hour p_max energies) or thermal-style convex bid
+curves (per-hour (power, cost) pairs).
 
-TPU-native difference: the reference builds one stacked Pyomo model with
-``fs`` indexed by scenario and hands it to a MILP solver; here the
-scenario axis is a ``vmap`` batch over the SAME compiled kernel with the
-price signal as the batched parameter (SURVEY.md §2.7 scenario
-parallelism).  Scenario results are combined by probability weight —
-the stochastic program's first stage; a hard non-anticipativity
-coupling across the batch is planned via a scenario-axis flowsheet.
+This IS the two-stage stochastic program, not a heuristic: the
+scenarios are stacked into one NLP (``core/stacked.py``) with
+non-anticipativity by construction — the SelfScheduler ties the
+delivered profile across scenarios through a shared first-stage
+schedule variable, and the Bidder enforces incentive-compatible
+bid-curve consistency ((pi_s - pi_s')(P_s - P_s') >= 0) so every
+scenario's dispatch lies on one monotone curve, from which the
+multi-segment (power, cumulative cost) pairs are read off.  The
+stacked program solves on the same IPM kernels; the scenario slabs are
+evaluated under ``vmap`` (SURVEY.md §2.7 scenario parallelism).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dispatches_tpu.core.stacked import StackedScenarioNLP
 from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
 
 
@@ -51,6 +55,9 @@ class _BidderBase:
         self.day_ahead_model = self._build(self.day_ahead_horizon)
         self.real_time_model = self._build(self.real_time_horizon)
 
+    #: stacked-program coupling mode; subclasses override
+    _coupling = "first_stage"
+
     def _build(self, horizon: int):
         blk = SimpleNamespace()
         self.bidding_model_object.populate_model(blk, horizon)
@@ -65,36 +72,35 @@ class _BidderBase:
             return revenue - cost
 
         blk.nlp = fs.compile(objective=objective, sense="max")
-        solver = make_ipm_solver(blk.nlp, IPMOptions(max_iter=self._max_iter))
-        blk.vsolve = jax.jit(
-            jax.vmap(
-                solver,
-                in_axes=(
-                    {
-                        "p": {
-                            k: (0 if k == "energy_price" else None)
-                            for k in blk.nlp.default_params()["p"]
-                        },
-                        "fixed": None,
-                    },
-                ),
-            )
+
+        md = self.bidding_model_object.model_data
+        blk.stacked = StackedScenarioNLP(
+            blk.nlp,
+            n_scenarios=self.n_scenario,
+            scenario_param_keys=["energy_price"],
+            first_stage_expr=blk.power_output_expr,
+            coupling=self._coupling,
+            price_key="energy_price",
+            first_stage_bounds=(md.p_min, md.p_max),
+            first_stage_scale=max(md.p_max, 1.0) / 2.0,
+        )
+        blk.solve = jax.jit(
+            make_ipm_solver(blk.stacked, IPMOptions(max_iter=self._max_iter))
         )
         return blk
 
-    def _scenario_solve(self, blk, prices: np.ndarray) -> np.ndarray:
-        """Solve all price scenarios batched; returns per-scenario power
-        profiles (n_scenario, horizon) in MW."""
-        params = blk.nlp.default_params()
+    def _scenario_solve(self, blk, prices: np.ndarray):
+        """Solve the stacked two-stage program; returns per-scenario
+        coupled power profiles (n_scenario, horizon) in MW and the
+        result (res.x is in the stacked space)."""
+        params = blk.stacked.default_params()
         batched = {
             "p": {**params["p"], "energy_price": jnp.asarray(prices)},
             "fixed": params["fixed"],
         }
-        res = blk.vsolve(batched)
-        sols = [blk.nlp.unravel(np.asarray(res.x)[s]) for s in range(len(prices))]
-        return np.stack(
-            [np.asarray(blk.power_output_values(s)) for s in sols]
-        ), res
+        res = blk.solve(batched)
+        powers = blk.stacked.scenario_profiles(res.x, batched)
+        return powers, res
 
     def _forecast(self, date, hour, horizon):
         bus = self.bidding_model_object.model_data.bus
@@ -115,13 +121,17 @@ class _BidderBase:
 
         if self.bids_result_list:
             pd.concat(self.bids_result_list).to_csv(path, index=False)
+        else:  # header-only file keeps the log readers working
+            pd.DataFrame(
+                columns=["Generator", "Date", "Hour", "Market", "HorizonHour"]
+            ).to_csv(path, index=False)
 
-    def record_bids(self, bids, date, hour):
+    def record_bids(self, bids, date, hour, market="Day-ahead"):
         import pandas as pd
 
         rows = [
             {"Generator": self.generator, "Date": date, "Hour": hour,
-             "HorizonHour": t, **info}
+             "Market": market, "HorizonHour": t, **info}
             for t, gen_bids in bids.items()
             for info in [
                 {k: v for k, v in gen_bids[self.generator].items()
@@ -137,8 +147,10 @@ class SelfScheduler(_BidderBase):
 
     def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
         prices = self._forecast(date, hour, self.day_ahead_horizon)  # $/MWh
-        powers, _ = self._scenario_solve(self.day_ahead_model, prices)
-        schedule = powers.mean(axis=0)  # probability-weighted first stage
+        _, res = self._scenario_solve(self.day_ahead_model, prices)
+        # the shared first-stage variable IS the self-schedule: hard
+        # non-anticipativity, not a mean of scenario optima
+        schedule = self.day_ahead_model.stacked.first_stage(res.x)
         md = self.bidding_model_object.model_data
         bids = {
             t: {
@@ -159,8 +171,8 @@ class SelfScheduler(_BidderBase):
                 date, hour, bus, self.real_time_horizon, self.n_scenario
             )
         )
-        powers, _ = self._scenario_solve(self.real_time_model, prices)
-        schedule = powers.mean(axis=0)
+        _, res = self._scenario_solve(self.real_time_model, prices)
+        schedule = self.real_time_model.stacked.first_stage(res.x)
         md = self.bidding_model_object.model_data
         return {
             t: {self.generator: {"p_min": md.p_min, "p_max": float(schedule[t])}}
@@ -169,20 +181,45 @@ class SelfScheduler(_BidderBase):
 
 
 class Bidder(_BidderBase):
-    """Thermal-style bidder: per-hour convex bid curves
-    (reference test :218-252: ``bids[t][gen]['p_cost']`` pairs)."""
+    """Thermal-style bidder: per-hour convex multi-segment bid curves
+    (reference test :218-252: ``bids[t][gen]['p_cost']`` pairs; curve
+    semantics per ``coordinator.py:46-81`` /
+    ``convert_marginal_costs_to_actual_costs``)."""
+
+    _coupling = "monotone"
 
     def _curves(self, prices: np.ndarray, powers: np.ndarray, horizon: int):
+        """Read the shared monotone bid curve off the scenario
+        solutions: the incentive-compatibility coupling guarantees
+        (price, power) pairs are co-monotone per hour, so sorting by
+        price gives the curve's breakpoints; costs are the integral of
+        the marginal prices (convex piecewise (power, total cost))."""
         md = self.bidding_model_object.model_data
-        mean_price = prices.mean(axis=0)
-        sched = powers.mean(axis=0)
         bids = {}
         for t in range(horizon):
-            price = float(mean_price[t])
-            if sched[t] > 1e-6 and price > 0:
-                curve = [(md.p_min, 0.0), (md.p_max, price * md.p_max)]
-            else:
+            order = np.argsort(prices[:, t], kind="stable")
+            pi = prices[order, t]
+            P = np.maximum.accumulate(np.maximum(powers[order, t], 0.0))
+            if P[-1] <= 1e-6 or pi[-1] <= 0:
                 curve = [(md.p_min, 0.0), (md.p_max, 0.0)]
+            else:
+                curve = [(float(md.p_min), 0.0)]
+                cost, p_prev = 0.0, float(md.p_min)
+                for k in range(len(pi)):
+                    pk = float(P[k])
+                    # solver-noise dedup: near-identical scenario
+                    # dispatches (within 1e-4 MW) collapse to one
+                    # breakpoint, else sliver segments get junk slopes
+                    if pk <= p_prev + 1e-4:
+                        continue
+                    cost += max(float(pi[k]), 0.0) * (pk - p_prev)
+                    curve.append((pk, cost))
+                    p_prev = pk
+                if p_prev < md.p_max - 1e-9:
+                    # extend to p_max at the top marginal price (the
+                    # S=1 reference curve is [(p_min,0),(p_max, pi*p_max)])
+                    cost += max(float(pi[-1]), 0.0) * (md.p_max - p_prev)
+                    curve.append((float(md.p_max), cost))
             bids[t] = {
                 self.generator: {
                     "p_min": md.p_min,

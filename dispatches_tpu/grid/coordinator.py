@@ -1,0 +1,140 @@
+"""DoubleLoopCoordinator: wires a bidder + trackers into the market
+co-simulation.
+
+Capability counterpart of the reference's ``workflow/coordinator.py``
+(:29-93) + the consumed ``idaes.apps.grid_integration``
+DoubleLoopCoordinator: the Prescient plugin-callback registration
+becomes plain method hooks the ``MarketSimulator`` calls at each market
+cycle — DA bids before the RUC, RT bids before each SCED, tracking after
+each dispatch, and static generator parameters pushed into the market's
+generator model (``update_static_params`` with the marginal-to-actual
+cost-curve conversion for thermal participants, reference :46-87).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def convert_marginal_costs_to_actual_costs(bid_pairs):
+    """[(power, marginal $/MWh)...] -> [(power, cumulative $)] (the
+    idaes helper consumed at reference ``run_double_loop.py:19-29``)."""
+    out = []
+    cost = 0.0
+    prev = None
+    for p, mc in bid_pairs:
+        if prev is not None:
+            cost += mc * (p - prev)
+        out.append((p, cost))
+        prev = p
+    return out
+
+
+class DoubleLoopCoordinator:
+    def __init__(self, bidder, tracker, projection_tracker):
+        self.bidder = bidder
+        self.tracker = tracker
+        self.projection_tracker = projection_tracker
+        self._hour_in_day = 0
+
+    # -- identity ------------------------------------------------------
+
+    @property
+    def generator_name(self) -> str:
+        return self.bidder.bidding_model_object.model_data.gen_name
+
+    def generator_bus(self, case) -> Optional[str]:
+        """Resolve the participant's bus id in the market case (the
+        model_data carries a bus NAME; RTS gen names prefix the id)."""
+        md = self.bidder.bidding_model_object.model_data
+        gen = md.gen_name
+        prefix = gen.split("_")[0]
+        if prefix in case.buses:
+            return prefix
+        return case.buses[0]
+
+    # -- static params (reference :46-87) ------------------------------
+
+    def update_static_params(self, gen_dict: Dict) -> None:
+        md = self.bidder.bidding_model_object.model_data
+        for param, value in md.to_dict().items():
+            if param == "gen_name" or value is None:
+                continue
+            if param == "p_cost" and md.generator_type == "thermal":
+                gen_dict[param] = {
+                    "data_type": "cost_curve",
+                    "cost_curve_type": "piecewise",
+                    "values": convert_marginal_costs_to_actual_costs(value),
+                }
+            else:
+                gen_dict[param] = value
+
+    # -- market-cycle hooks -------------------------------------------
+
+    def request_da_bids(self, date):
+        bids = self.bidder.compute_day_ahead_bids(date=date)
+        self.bidder.record_bids(bids, date, 0, market="Day-ahead")
+        return bids
+
+    def request_rt_bids(self, date, hour, da_lmp=None):
+        bids = self.bidder.compute_real_time_bids(
+            date, hour, realized_day_ahead_prices=da_lmp
+        )
+        self.bidder.record_bids(bids, date, hour, market="Real-time")
+        return bids
+
+    def push_da_results(self, date, da_lmp, da_dispatch, bus_lmps):
+        """Record realized DA prices into the forecaster's history and
+        warm the projection tracker on the DA schedule."""
+        bus = self.bidder.bidding_model_object.model_data.bus
+        fc = self.bidder.forecaster
+        if hasattr(fc, "record_day_ahead_price"):
+            lmps = bus_lmps.get(bus)
+            if lmps is None and bus_lmps:
+                lmps = next(iter(bus_lmps.values()))
+            fc.record_day_ahead_price(bus, list(np.asarray(lmps)[:24]))
+        h = self.projection_tracker.tracking_horizon
+        self.projection_tracker.track_market_dispatch(
+            np.asarray(da_dispatch)[:h], date=date, hour=0
+        )
+
+    def push_rt_dispatch(self, date, hour, dispatch_mw, bus_lmps):
+        """Track the cleared real-time dispatch; feed realized prices
+        back to the forecaster (reference coordinator's hourly stats
+        callback)."""
+        h = self.tracker.tracking_horizon
+        signal = np.full(h, float(dispatch_mw))
+        self.tracker.track_market_dispatch(signal, date=date, hour=hour)
+        fc = self.bidder.forecaster
+        if hasattr(fc, "fetch_hourly_stats_from_prescient"):
+            bus = self.bidder.bidding_model_object.model_data.bus
+            price = bus_lmps.get(bus)
+            if price is None and bus_lmps:
+                price = next(iter(bus_lmps.values()))
+            fc.fetch_hourly_stats_from_prescient({bus: float(price)})
+        # advance the bidder's operating models with the implemented
+        # profile every 24 implemented hours
+        self._hour_in_day += 1
+        if self._hour_in_day >= 24 and self.tracker.implemented_stats:
+            self._hour_in_day = 0
+            profile = self.tracker.implemented_stats[-1]
+            try:
+                self.bidder.update_day_ahead_model(**profile)
+                self.bidder.update_real_time_model(**profile)
+            except TypeError:
+                pass
+        return self.tracker.get_last_delivered_power()
+
+    # -- results -------------------------------------------------------
+
+    def write_results(self, path):
+        from pathlib import Path
+
+        path = Path(path)
+        self.bidder.write_results(path / "bidder_detail.csv")
+        self.tracker.write_results(path / "tracker_detail.csv")
+        self.projection_tracker.write_results(
+            path / "tracking_model_detail.csv"
+        )

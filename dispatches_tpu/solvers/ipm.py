@@ -186,7 +186,17 @@ def make_ipm_solver(
             (26k x 44k) that plus its jvp batch exceeds 100 GB RSS
             (measured)."""
             rows = np.zeros(m_rows)
-            chunk = max(1, min(n_x, int(2_000_000 // max(m_rows, 1)) or 1))
+            # bound BOTH the (chunk, m_rows) jvp output and the
+            # (chunk, n_x) basis — a small constraint block must not
+            # unbound the basis allocation
+            chunk = max(
+                1,
+                min(
+                    n_x,
+                    int(2_000_000 // max(m_rows, 1)) or 1,
+                    int(2_000_000 // max(n_x, 1)) or 1,
+                ),
+            )
             jac_cols = jax.jit(
                 lambda basis: jax.vmap(
                     lambda v: jax.jvp(fn, (x0_,), (v,))[1]
