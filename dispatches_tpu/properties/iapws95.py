@@ -1,0 +1,552 @@
+"""Differentiable IAPWS-95 water/steam properties in pure JAX.
+
+Capability counterpart of the IDAES ``iapws95`` property package the
+reference's fossil case is built on (``ultra_supercritical_powerplant.py:81``
+``iapws95.Iapws95ParameterBlock``; consumed by every Helm power-plant unit).
+The reference reaches IAPWS-95 through compiled C "idaes extensions"
+external functions (SURVEY.md section 2.6) — opaque to AD, evaluated
+point-wise on the host.  Here the full Helmholtz-energy formulation
+(IAPWS Release 1995 / Wagner & Pruss 2002, J.Phys.Chem.Ref.Data 31:387)
+is a pair of pure-JAX scalar fields ``phi0(delta, tau)`` and
+``phir(delta, tau)``; every thermodynamic property is an explicit
+closed-form expression in those fields and their AD derivatives, so
+
+* properties are batched: one ``vmap``/broadcast evaluates the EoS for
+  every stream of a flowsheet (or every scenario of a sweep) at once on
+  the MXU instead of one C call per state;
+* properties are differentiable to arbitrary order: ``jax.grad`` through
+  the EoS replaces the reference's finite external-function derivatives,
+  so KKT systems of steam-cycle NLPs are exact.
+
+Flowsheet states do NOT call iterative flashes in-graph: steam states
+expose (T, delta) or (T, x, delta_l, delta_v) as auxiliary NLP variables
+whose defining residuals are the explicit EoS relations (the pattern of
+``models/steam_cycle.py``).  The iterative helpers in this module
+(`rho_tp`, `flash_hp`, `sat_p`, ...) are host-side warm-start utilities
+for initialization ladders — the TPU-native replacement for the
+reference's sequential-modular ``initialize()`` chains.
+
+All public thermodynamic functions use MOLAR SI units (J/mol, mol/s)
+matching the IDAES Helm state (flow_mol, enth_mol, pressure), with
+``delta = rho / RHOC`` the reduced density and temperature in K.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ----------------------------------------------------------------------
+# Constants (IAPWS-95 Release; Wagner & Pruss 2002 Table 6.1/6.2)
+# ----------------------------------------------------------------------
+
+TC = 647.096  # K, critical temperature
+RHOC = 322.0  # kg/m^3, critical density
+PC = 22.064e6  # Pa, critical pressure
+R_MASS = 461.51805  # J/(kg K), specific gas constant
+MW = 0.01801528  # kg/mol (IDAES iapws95 molecular weight)
+R_MOL = R_MASS * MW  # J/(mol K)
+
+# Ideal-gas part coefficients (Table 6.1; n1/n2 from the revised release
+# so that h = u = 0 for saturated liquid at the triple point)
+_N0 = np.array([-8.3204464837497, 6.6832105275932, 3.00632])
+_N0E = np.array([0.012436, 0.97315, 1.27950, 0.96956, 0.24873])
+_G0E = np.array([1.28728967, 3.53734222, 7.74073708, 9.24437796, 27.5075105])
+
+# Residual part (Table 6.2): terms 1-7 polynomial, 8-51 exponential,
+# 52-54 Gaussian, 55-56 nonanalytic.
+_C = np.array(
+    [0] * 7
+    + [1] * 15
+    + [2] * 20
+    + [3] * 4
+    + [4]
+    + [6] * 4,
+    dtype=np.float64,
+)
+_D = np.array(
+    [1, 1, 1, 2, 2, 3, 4,
+     1, 1, 1, 2, 2, 3, 4, 4, 5, 7, 9, 10, 11, 13, 15,
+     1, 2, 2, 2, 3, 4, 4, 4, 5, 6, 6, 7, 9, 9, 9, 9, 9, 10, 10, 12,
+     3, 4, 4, 5, 14, 3, 6, 6, 6],
+    dtype=np.float64,
+)
+_T = np.array(
+    [-0.5, 0.875, 1.0, 0.5, 0.75, 0.375, 1.0,
+     4.0, 6.0, 12.0, 1.0, 5.0, 4.0, 2.0, 13.0, 9.0, 3.0, 4.0, 11.0, 4.0,
+     13.0, 1.0,
+     7.0, 1.0, 9.0, 10.0, 10.0, 3.0, 7.0, 10.0, 10.0, 6.0, 10.0, 10.0,
+     1.0, 2.0, 3.0, 4.0, 8.0, 6.0, 9.0, 8.0,
+     16.0, 22.0, 23.0, 23.0, 10.0, 50.0, 44.0, 46.0, 50.0],
+    dtype=np.float64,
+)
+_N = np.array(
+    [0.12533547935523e-1, 0.78957634722828e1, -0.87803203303561e1,
+     0.31802509345418, -0.26145533859358, -0.78199751687981e-2,
+     0.88089493102134e-2,
+     -0.66856572307965, 0.20433810950965, -0.66212605039687e-4,
+     -0.19232721156002, -0.25709043003438, 0.16074868486251,
+     -0.40092828925807e-1, 0.39343422603254e-6, -0.75941377088144e-5,
+     0.56250979351888e-3, -0.15608652257135e-4, 0.11537996422951e-8,
+     0.36582165144204e-6, -0.13251180074668e-11, -0.62639586912454e-9,
+     -0.10793600908932, 0.17611491008752e-1, 0.22132295167546,
+     -0.40247669763528, 0.58083399985759, 0.49969146990806e-2,
+     -0.31358700712549e-1, -0.74315929710341, 0.47807329915480,
+     0.20527940895948e-1, -0.13636435110343, 0.14180634400617e-1,
+     0.83326504880713e-2, -0.29052336009585e-1, 0.38615085574206e-1,
+     -0.20393486513704e-1, -0.16554050063734e-2, 0.19955571979541e-2,
+     0.15870308324157e-3, -0.16388568342530e-4,
+     0.43613615723811e-1, 0.34994005463765e-1, -0.76788197844621e-1,
+     0.22446277332006e-1, -0.62689710414685e-4, -0.55711118565645e-9,
+     -0.19905718354408, 0.31777497330738, -0.11841182425981],
+    dtype=np.float64,
+)
+
+# Gaussian terms 52-54
+_NG = np.array([-0.31306260323435e2, 0.31546140237781e2, -0.25213154341695e4])
+_DG = np.array([3.0, 3.0, 3.0])
+_TG = np.array([0.0, 1.0, 4.0])
+_ALPHA = np.array([20.0, 20.0, 20.0])
+_BETA_G = np.array([150.0, 150.0, 250.0])
+_GAMMA_G = np.array([1.21, 1.21, 1.25])
+_EPS_G = np.array([1.0, 1.0, 1.0])
+
+# Nonanalytic terms 55-56
+_NNA = np.array([-0.14874640856724, 0.31806110878444])
+_A_NA = np.array([3.5, 3.5])
+_B_NA = np.array([0.85, 0.95])
+_BB_NA = np.array([0.2, 0.2])
+_CC_NA = np.array([28.0, 32.0])
+_DD_NA = np.array([700.0, 800.0])
+_AA_NA = np.array([0.32, 0.32])
+_BETA_NA = np.array([0.3, 0.3])
+
+
+# ----------------------------------------------------------------------
+# Helmholtz fields
+# ----------------------------------------------------------------------
+
+def phi0(delta, tau):
+    """Ideal-gas part of the dimensionless Helmholtz energy."""
+    delta = jnp.asarray(delta)
+    tau = jnp.asarray(tau)
+    e = jnp.sum(
+        _N0E * jnp.log(-jnp.expm1(-_G0E * tau[..., None])), axis=-1
+    )
+    return (
+        jnp.log(delta) + _N0[0] + _N0[1] * tau + _N0[2] * jnp.log(tau) + e
+    )
+
+
+def phir(delta, tau):
+    """Residual part of the dimensionless Helmholtz energy (56 terms)."""
+    delta = jnp.asarray(delta)
+    tau = jnp.asarray(tau)
+    d = delta[..., None]
+    t = tau[..., None]
+
+    # terms 1..51: n d^di t^ti exp(-d^ci) (c=0 -> no exponential)
+    poly = _N * d ** _D * t ** _T
+    expo = jnp.where(_C > 0, jnp.exp(-jnp.where(_C > 0, d ** _C, 0.0)), 1.0)
+    s = jnp.sum(poly * expo, axis=-1)
+
+    # Gaussian terms 52..54
+    g = jnp.sum(
+        _NG
+        * d ** _DG
+        * t ** _TG
+        * jnp.exp(-_ALPHA * (d - _EPS_G) ** 2 - _BETA_G * (t - _GAMMA_G) ** 2),
+        axis=-1,
+    )
+
+    # Nonanalytic terms 55..56 (guarded so AD stays finite off-critical)
+    dm1sq = (d - 1.0) ** 2 + 1e-30
+    theta = (1.0 - t) + _AA_NA * dm1sq ** (1.0 / (2.0 * _BETA_NA))
+    Delta = theta ** 2 + _BB_NA * dm1sq ** _A_NA + 1e-30
+    psi = jnp.exp(-_CC_NA * (d - 1.0) ** 2 - _DD_NA * (t - 1.0) ** 2)
+    na = jnp.sum(_NNA * Delta ** _B_NA * d * psi, axis=-1)
+
+    return s + g + na
+
+
+# First partials via AD (closed-form fields -> exact derivatives; these
+# are themselves jittable/differentiable, so flowsheet residuals built on
+# them support the IPM's Hessian-vector products).
+_phir_d = jax.grad(lambda d, t: jnp.sum(phir(d, t)), argnums=0)
+_phir_t = jax.grad(lambda d, t: jnp.sum(phir(d, t)), argnums=1)
+_phi0_t = jax.grad(lambda d, t: jnp.sum(phi0(d, t)), argnums=1)
+
+
+def phir_d(delta, tau):
+    return _phir_d(jnp.asarray(delta, jnp.float64), jnp.asarray(tau, jnp.float64))
+
+
+def phir_t(delta, tau):
+    return _phir_t(jnp.asarray(delta, jnp.float64), jnp.asarray(tau, jnp.float64))
+
+
+def phi0_t(delta, tau):
+    return _phi0_t(jnp.asarray(delta, jnp.float64), jnp.asarray(tau, jnp.float64))
+
+
+# ----------------------------------------------------------------------
+# Properties on (delta, T) — molar SI
+# ----------------------------------------------------------------------
+
+def p_dT(delta, T):
+    """Pressure [Pa] from reduced density and temperature."""
+    tau = TC / T
+    rho = delta * RHOC
+    return rho * R_MASS * T * (1.0 + delta * phir_d(delta, tau))
+
+
+def h_dT(delta, T):
+    """Molar enthalpy [J/mol]."""
+    tau = TC / T
+    return (
+        R_MOL
+        * T
+        * (1.0 + tau * (phi0_t(delta, tau) + phir_t(delta, tau))
+           + delta * phir_d(delta, tau))
+    )
+
+
+def s_dT(delta, T):
+    """Molar entropy [J/mol/K]."""
+    tau = TC / T
+    return R_MOL * (
+        tau * (phi0_t(delta, tau) + phir_t(delta, tau))
+        - phi0(delta, tau)
+        - phir(delta, tau)
+    )
+
+
+def u_dT(delta, T):
+    """Molar internal energy [J/mol]."""
+    tau = TC / T
+    return R_MOL * T * tau * (phi0_t(delta, tau) + phir_t(delta, tau))
+
+
+def g_dT(delta, T):
+    """Molar Gibbs energy [J/mol] (phase-equilibrium residuals)."""
+    tau = TC / T
+    return R_MOL * T * (
+        1.0 + phi0(delta, tau) + phir(delta, tau) + delta * phir_d(delta, tau)
+    )
+
+
+def cv_dT(delta, T):
+    tau = TC / T
+    phi_tt = jax.grad(
+        lambda tt: jnp.sum(phi0_t(delta, tt) + phir_t(delta, tt))
+    )(tau)
+    return -R_MOL * tau ** 2 * phi_tt
+
+
+def cp_dT(delta, T):
+    tau = TC / T
+    pd = phir_d(delta, tau)
+    pdd = jax.grad(lambda dd: jnp.sum(phir_d(dd, tau)))(jnp.asarray(delta, jnp.float64))
+    pdt = jax.grad(lambda tt: jnp.sum(phir_d(delta, tt)))(jnp.asarray(tau, jnp.float64))
+    num = (1.0 + delta * pd - delta * tau * pdt) ** 2
+    den = 1.0 + 2.0 * delta * pd + delta ** 2 * pdd
+    return cv_dT(delta, T) + R_MOL * num / den
+
+
+def w_dT(delta, T):
+    """Speed of sound [m/s] (mass basis; validation only)."""
+    tau = TC / T
+    pd = phir_d(delta, tau)
+    pdd = jax.grad(lambda dd: jnp.sum(phir_d(dd, tau)))(jnp.asarray(delta, jnp.float64))
+    pdt = jax.grad(lambda tt: jnp.sum(phir_d(delta, tt)))(jnp.asarray(tau, jnp.float64))
+    # w^2/(R T) = 1 + 2 d pd + d^2 pdd - (1 + d pd - d tau pdt)^2
+    #             / (tau^2 (phi0_tt + phir_tt))
+    phi_tt = jax.grad(
+        lambda tt: jnp.sum(phi0_t(delta, tt) + phir_t(delta, tt))
+    )(tau)
+    w2 = R_MASS * T * (
+        1.0 + 2.0 * delta * pd + delta ** 2 * pdd
+        - (1.0 + delta * pd - delta * tau * pdt) ** 2 / (tau ** 2 * phi_tt)
+    )
+    return jnp.sqrt(w2)
+
+
+# ----------------------------------------------------------------------
+# Wagner-Pruss auxiliary saturation equations (explicit; initial guesses)
+# ----------------------------------------------------------------------
+
+_PS_A = np.array([-7.85951783, 1.84408259, -11.7866497,
+                  22.6807411, -15.9618719, 1.80122502])
+_RL_B = np.array([1.99274064, 1.09965342, -0.510839303,
+                  -1.75493479, -45.5170352, -6.74694450e5])
+_RV_C = np.array([-2.03150240, -2.68302940, -5.38626492,
+                  -17.2991605, -44.7586581, -63.9201063])
+
+
+def sat_p_aux(T):
+    """Saturation pressure [Pa], explicit auxiliary equation."""
+    T = jnp.asarray(T)
+    th = 1.0 - T / TC
+    poly = (_PS_A[0] * th + _PS_A[1] * th ** 1.5 + _PS_A[2] * th ** 3
+            + _PS_A[3] * th ** 3.5 + _PS_A[4] * th ** 4 + _PS_A[5] * th ** 7.5)
+    return PC * jnp.exp(TC / T * poly)
+
+
+def sat_rhol_aux(T):
+    """Saturated-liquid density [kg/m^3], explicit auxiliary equation."""
+    T = jnp.asarray(T)
+    th = 1.0 - T / TC
+    b = (1.0 + _RL_B[0] * th ** (1 / 3) + _RL_B[1] * th ** (2 / 3)
+         + _RL_B[2] * th ** (5 / 3) + _RL_B[3] * th ** (16 / 3)
+         + _RL_B[4] * th ** (43 / 3) + _RL_B[5] * th ** (110 / 3))
+    return RHOC * b
+
+
+def sat_rhov_aux(T):
+    """Saturated-vapor density [kg/m^3], explicit auxiliary equation."""
+    T = jnp.asarray(T)
+    th = 1.0 - T / TC
+    c = (_RV_C[0] * th ** (2 / 6) + _RV_C[1] * th ** (4 / 6)
+         + _RV_C[2] * th ** (8 / 6) + _RV_C[3] * th ** (18 / 6)
+         + _RV_C[4] * th ** (37 / 6) + _RV_C[5] * th ** (71 / 6))
+    return RHOC * jnp.exp(c)
+
+
+# ----------------------------------------------------------------------
+# Host-side solvers (float64 numpy scalars/arrays; initialization only)
+# ----------------------------------------------------------------------
+
+def _np(x):
+    return np.asarray(x, dtype=np.float64)
+
+
+# Jitted value+derivative kernels for the host Newton loops (module-level
+# so repeated calls hit the jit cache instead of retracing per iteration).
+@jax.jit
+def _p_dp(d, T):
+    p = p_dT(d, T)
+    dp = jax.grad(lambda dd: jnp.sum(p_dT(dd, T)))(d)
+    return p, dp
+
+
+@jax.jit
+def _g_dg(d, T):
+    g = g_dT(d, T)
+    dg = jax.grad(lambda dd: jnp.sum(g_dT(dd, T)))(d)
+    return g, dg
+
+
+_h_jit = jax.jit(h_dT)
+_s_jit = jax.jit(s_dT)
+_satp_jit = jax.jit(sat_p_aux)
+
+
+def sat_solve_T(T):
+    """Maxwell-polished saturation state at temperature T [K].
+
+    Returns (p_sat [Pa], delta_l, delta_v).  Newton on
+    [p(dl) - p(dv), g(dl) - g(dv)] from the auxiliary-equation guesses —
+    this reproduces the exact IAPWS-95 phase boundary (the auxiliary
+    equations alone are only ~0.01-0.1% accurate).
+    """
+    T = _np(T)
+    dl = _np(sat_rhol_aux(T)) / RHOC
+    dv = _np(sat_rhov_aux(T)) / RHOC
+    for _ in range(30):
+        pl, dpl = (_np(a) for a in _p_dp(jnp.asarray(dl), jnp.asarray(T)))
+        pv, dpv = (_np(a) for a in _p_dp(jnp.asarray(dv), jnp.asarray(T)))
+        gl, dgl = (_np(a) for a in _g_dg(jnp.asarray(dl), jnp.asarray(T)))
+        gv, dgv = (_np(a) for a in _g_dg(jnp.asarray(dv), jnp.asarray(T)))
+        f1 = pl - pv
+        f2 = (gl - gv) / R_MOL / T
+        dgl = dgl / R_MOL / T
+        dgv = dgv / R_MOL / T
+        det = dpl * (-dgv) - (-dpv) * dgl
+        det = np.where(np.abs(det) < 1e-300, 1e-300, det)
+        ddl = (f1 * (-dgv) - (-dpv) * f2) / det
+        ddv = (dpl * f2 - dgl * f1) / det
+        step = 1.0
+        dl = np.clip(dl - step * ddl, 1e-8, 4.2)
+        dv = np.clip(dv - step * ddv, 1e-10, 1.05)
+        if np.all(np.abs(f1) < 1e-6) and np.all(np.abs(f2) < 1e-12):
+            break
+    return _np(p_dT(dl, T)), dl, dv
+
+
+def sat_solve_P(P):
+    """Saturation state at pressure P [Pa]: returns (T_sat, delta_l, delta_v)."""
+    P = _np(P)
+    # invert the auxiliary ps(T) by bisection for the T guess
+    lo = np.full(np.shape(P), 273.16)
+    hi = np.full(np.shape(P), TC - 1e-6)
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        lowr = _np(_satp_jit(jnp.asarray(mid))) < P
+        lo = np.where(lowr, mid, lo)
+        hi = np.where(lowr, hi, mid)
+    T = 0.5 * (lo + hi)
+    # polish with Maxwell solve + 1D secant on p_sat(T) - P
+    for _ in range(12):
+        ps, dl, dv = sat_solve_T(T)
+        err = ps - P
+        dT = 0.01
+        ps2, _, _ = sat_solve_T(T + dT)
+        dpdT = (ps2 - ps) / dT
+        T = T - err / np.where(np.abs(dpdT) < 1e-300, 1e-300, dpdT)
+        if np.all(np.abs(err) < 1e-4 * P):
+            pass
+    ps, dl, dv = sat_solve_T(T)
+    return T, dl, dv
+
+
+def rho_tp(T, P, phase):
+    """Density [kg/m^3] at (T, P) by host Newton on p_dT.
+
+    ``phase``: 'liq' or 'vap' selects the branch via the initial guess
+    (liquid-like vs ideal-gas-like); supercritical states accept either.
+    """
+    T = _np(T)
+    P = _np(P)
+    if phase == "liq":
+        d = np.broadcast_to(
+            np.where(T < TC, _np(sat_rhol_aux(np.minimum(T, TC - 1e-3))) / RHOC, 1.8),
+            np.broadcast_shapes(T.shape, P.shape),
+        ).copy()
+        d = np.maximum(d, 1.0)
+    else:
+        d = np.broadcast_to(
+            P / (R_MASS * T * RHOC), np.broadcast_shapes(T.shape, P.shape)
+        ).copy()
+        d = np.minimum(d, 0.9)
+    for _ in range(80):
+        pv, dpv = _p_dp(jnp.asarray(d), jnp.asarray(T))
+        f = _np(pv) - P
+        df = _np(dpv)
+        df = np.where(np.abs(df) < 1e-300, 1e-300, df)
+        step = f / df
+        # keep Newton on the declared branch
+        dn = d - np.clip(step, -0.25 * np.maximum(d, 0.05), 0.25 * np.maximum(d, 0.05))
+        d = np.clip(dn, 1e-10, 4.2)
+        if np.all(np.abs(f) < 1e-7 * np.maximum(P, 1.0)):
+            break
+    return d * RHOC
+
+
+def props_tp(T, P, phase):
+    """dict of molar properties at single-phase (T, P)."""
+    d = rho_tp(T, P, phase) / RHOC
+    return {
+        "delta": d,
+        "rho": d * RHOC,
+        "h": _np(h_dT(d, T)),
+        "s": _np(s_dT(d, T)),
+        "g": _np(g_dT(d, T)),
+    }
+
+
+def flash_hp(h, P):
+    """Host flash at (molar enthalpy, pressure).
+
+    Returns dict with T, x (vapor fraction; clipped to [0,1] report),
+    delta_l, delta_v, delta (mixture-consistent), s, phase tag.
+    """
+    h = _np(h)
+    P = _np(P)
+    scalar = h.ndim == 0 and P.ndim == 0
+    h = np.atleast_1d(h)
+    P = np.atleast_1d(P)
+    out = {k: np.zeros(np.broadcast_shapes(h.shape, P.shape))
+           for k in ("T", "x", "delta_l", "delta_v", "s")}
+    phase = np.empty(out["T"].shape, dtype=object)
+    h, P = np.broadcast_arrays(h, P)
+    for i in np.ndindex(h.shape):
+        hi, Pi = float(h[i]), float(P[i])
+        if Pi < PC:
+            Ts, dl, dv = sat_solve_P(Pi)
+            hl = float(_h_jit(dl, Ts))
+            hv = float(_h_jit(dv, Ts))
+            if hl <= hi <= hv:
+                x = (hi - hl) / (hv - hl)
+                sl = float(_s_jit(dl, Ts))
+                sv = float(_s_jit(dv, Ts))
+                out["T"][i] = Ts
+                out["x"][i] = x
+                out["delta_l"][i] = dl
+                out["delta_v"][i] = dv
+                out["s"][i] = (1 - x) * sl + x * sv
+                phase[i] = "two-phase"
+                continue
+            br = "liq" if hi < hl else "vap"
+        else:
+            # supercritical: pick branch by enthalpy vs a mid guess
+            br = "liq" if hi < 25000.0 else "vap"
+        # 1D Newton on T with rho_tp inner solve
+        T = _guess_T_hp(hi, Pi, br)
+        for _ in range(60):
+            d = rho_tp(T, Pi, br) / RHOC
+            f = float(_h_jit(d, T)) - hi
+            dT = max(1e-3, 1e-6 * T)
+            d2 = rho_tp(T + dT, Pi, br) / RHOC
+            df = (float(_h_jit(d2, T + dT)) - hi - f) / dT
+            if df == 0:
+                break
+            Tn = T - f / df
+            T = float(np.clip(Tn, 254.0, 1400.0))
+            if abs(f) < 1e-7 * max(abs(hi), 1.0):
+                break
+        d = rho_tp(T, Pi, br) / RHOC
+        out["T"][i] = T
+        out["x"][i] = 0.0 if br == "liq" else 1.0
+        out["delta_l"][i] = d if br == "liq" else 0.0
+        out["delta_v"][i] = d if br == "vap" else 0.0
+        out["s"][i] = float(_s_jit(d, T))
+        phase[i] = br
+    out["phase"] = phase
+    if scalar:
+        out = {k: (v[0] if isinstance(v, np.ndarray) else v[(0,)])
+               for k, v in out.items()}
+    return out
+
+
+def _guess_T_hp(h, P, phase):
+    if phase == "liq":
+        # liquid enthalpy roughly cp ~ 75.3 J/mol/K from 273 K
+        return float(np.clip(273.15 + h / 75.3, 260.0, 640.0))
+    # vapor: ideal-gas-like estimate around 2000 + 35 T
+    return float(np.clip((h - 40000.0) / 36.0 + 500.0, 280.0, 1350.0))
+
+
+def h_ps(P, s, phase):
+    """Host inverse: molar enthalpy at (P, s) on a declared branch, with
+    two-phase handling below the dome (isentropic-expansion warm starts).
+    """
+    P = float(P)
+    s = float(s)
+    if P < PC:
+        Ts, dl, dv = sat_solve_P(P)
+        sl = float(_s_jit(dl, Ts))
+        sv = float(_s_jit(dv, Ts))
+        if sl <= s <= sv:
+            x = (s - sl) / (sv - sl)
+            hl = float(_h_jit(dl, Ts))
+            hv = float(_h_jit(dv, Ts))
+            return (1 - x) * hl + x * hv
+        branch = "liq" if s < sl else "vap"
+    else:
+        branch = phase
+    # Newton on T: s(T, P) = s
+    T = 300.0 if branch == "liq" else 600.0
+    for _ in range(80):
+        d = rho_tp(T, P, branch) / RHOC
+        f = float(_s_jit(d, T)) - s
+        dT = max(1e-3, 1e-6 * T)
+        d2 = rho_tp(T + dT, P, branch) / RHOC
+        df = (float(_s_jit(d2, T + dT)) - s - f) / dT
+        if df == 0:
+            break
+        T = float(np.clip(T - f / df, 254.0, 1400.0))
+        if abs(f) < 1e-10 * max(abs(s), 1.0):
+            break
+    d = rho_tp(T, P, branch) / RHOC
+    return float(_h_jit(d, T))

@@ -1,0 +1,96 @@
+"""IAPWS-95 verification against the published check tables.
+
+The reference consumes IAPWS-95 through the IDAES compiled extensions
+(``ultra_supercritical_powerplant.py:81``); our pure-JAX implementation
+is verified directly against the IAPWS Release / Wagner & Pruss (2002)
+verification values: Table 7 (single-phase P, cv, w, s at given T, rho)
+and Table 8 (saturation p, rho', rho'').
+"""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.properties import iapws95 as w95
+
+# (T [K], rho [kg/m3], P [MPa], cv [kJ/kg/K], w [m/s], s [kJ/kg/K])
+TABLE7 = [
+    (300.0, 0.9965560e3, 0.992418352e-1, 4.13018112, 1501.51914, 0.393062643),
+    (300.0, 0.1005308e4, 0.200022515e2, 4.06798347, 1534.92501, 0.387405401),
+    (300.0, 0.1188202e4, 0.700004704e3, 3.46135580, 2443.57992, 0.132609616),
+    (500.0, 0.435000e0, 0.999679423e-1, 1.50817541, 548.314253, 7.94488271),
+    (500.0, 0.453200e1, 0.999938125e0, 1.66991025, 535.739001, 6.82502725),
+    (500.0, 0.838025e3, 0.100003858e2, 3.22106219, 1271.28441, 2.56690919),
+    (500.0, 0.1084564e4, 0.700000405e3, 3.07437693, 2412.00877, 2.03237509),
+    (647.0, 0.358000e3, 0.220384756e2, 6.18315728, 252.145078, 4.32092307),
+    (900.0, 0.241000e0, 0.100062559e0, 1.75890657, 724.027147, 9.16653194),
+    (900.0, 0.526150e2, 0.200000690e2, 1.93510526, 698.445674, 6.59070225),
+    (900.0, 0.870769e3, 0.700000006e3, 2.66422350, 2019.33608, 4.17223802),
+]
+
+# (T [K], p [MPa], rho_liq [kg/m3], rho_vap [kg/m3])
+TABLE8 = [
+    (275.0, 0.698451167e-3, 0.999887406e3, 0.550664919e-2),
+    (450.0, 0.932203564e0, 0.890341250e3, 0.481200360e1),
+    (625.0, 0.169082693e2, 0.567090385e3, 0.118290280e3),
+]
+
+
+@pytest.mark.parametrize("T,rho,P,cv,w,s", TABLE7)
+def test_single_phase_points(T, rho, P, cv, w, s):
+    d = rho / w95.RHOC
+    assert float(w95.p_dT(d, T)) / 1e6 == pytest.approx(P, rel=1e-7)
+    assert float(w95.cv_dT(d, T)) / w95.MW / 1e3 == pytest.approx(cv, rel=1e-7)
+    assert float(w95.w_dT(d, T)) == pytest.approx(w, rel=1e-7)
+    assert float(w95.s_dT(d, T)) / w95.MW / 1e3 == pytest.approx(s, rel=1e-7)
+
+
+@pytest.mark.parametrize("T,p,rl,rv", TABLE8)
+def test_saturation_points(T, p, rl, rv):
+    ps, dl, dv = w95.sat_solve_T(T)
+    assert ps / 1e6 == pytest.approx(p, rel=1e-7)
+    assert dl * w95.RHOC == pytest.approx(rl, rel=1e-7)
+    assert dv * w95.RHOC == pytest.approx(rv, rel=1e-7)
+
+
+def test_sat_solve_p_round_trip():
+    for P in (6896.0, 1.0e5, 1.0e6, 1.0e7):
+        T, dl, dv = w95.sat_solve_P(P)
+        ps, _, _ = w95.sat_solve_T(T)
+        assert ps == pytest.approx(P, rel=1e-6)
+
+
+def test_flash_hp_two_phase():
+    # 1 bar, mid-dome: T must equal Tsat(1 bar) = 372.756 K
+    st = w95.flash_hp(30000.0, 1.0e5)
+    assert st["phase"] == "two-phase"
+    assert st["T"] == pytest.approx(372.7559, rel=1e-4)
+    hl = float(w95.h_dT(st["delta_l"], st["T"]))
+    hv = float(w95.h_dT(st["delta_v"], st["T"]))
+    assert (1 - st["x"]) * hl + st["x"] * hv == pytest.approx(30000.0, rel=1e-9)
+
+
+def test_flash_hp_single_phase_round_trip():
+    # superheated vapor and compressed liquid round-trips through props_tp
+    for (T, P, phase) in [(866.15, 31125980.0, "vap"), (600.0, 3.0e6, "vap"),
+                          (310.0, 1.0e6, "liq"), (570.0, 32.2e6, "liq")]:
+        pr = w95.props_tp(T, P, phase)
+        st = w95.flash_hp(pr["h"], P)
+        assert st["phase"] == phase
+        assert st["T"] == pytest.approx(T, rel=1e-6)
+
+
+def test_h_ps_isentropic_consistency():
+    # expanding main steam isentropically must preserve entropy
+    pr = w95.props_tp(866.15, 31125980.0, "vap")
+    P2 = 0.388 * 31125980.0
+    h2 = w95.h_ps(P2, pr["s"], "vap")
+    st = w95.flash_hp(h2, P2)
+    assert st["s"] == pytest.approx(pr["s"], rel=1e-8)
+
+
+def test_molar_mass_consistency():
+    # liquid water at ambient: h ~ 75.3 J/mol/K heat capacity scale
+    pr300 = w95.props_tp(300.0, 101325.0, "liq")
+    pr310 = w95.props_tp(310.0, 101325.0, "liq")
+    cp = (pr310["h"] - pr300["h"]) / 10.0
+    assert cp == pytest.approx(75.3, rel=0.01)
