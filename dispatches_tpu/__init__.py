@@ -28,6 +28,19 @@ if not os.environ.get("DISPATCHES_TPU_NO_X64"):
 
     jax.config.update("jax_enable_x64", True)
 
+if not os.environ.get("DISPATCHES_TPU_NO_COMPILE_CACHE"):
+    # Persistent XLA compilation cache: flowsheet solve kernels (IPM over
+    # a few-hundred-variable NLP) take minutes to compile on a small host
+    # but are identical across processes/test runs — cache them on disk.
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DISPATCHES_TPU_COMPILE_CACHE",
+                       os.path.expanduser("~/.cache/dispatches_tpu_xla")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
 from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec  # noqa: E402
 from dispatches_tpu.core.compile import CompiledNLP  # noqa: E402
 

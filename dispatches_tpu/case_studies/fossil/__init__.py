@@ -1,0 +1,10 @@
+"""Fossil case study: ultra-supercritical pulverized-coal plant,
+supercritical plant + concrete TES, and molten-salt storage integration
+(capability counterpart of ``dispatches/case_studies/fossil_case/``)."""
+
+from dispatches_tpu.case_studies.fossil.usc_plant import (  # noqa: F401
+    build_plant_model,
+    initialize,
+    model_analysis,
+    solve_plant,
+)

@@ -142,6 +142,11 @@ class Flowsheet:
         self.params: Dict[str, np.ndarray] = {}
         self.constraints: List[_Constraint] = []
         self._n_anon = 0
+        # build finalizers: run once at first compile (used by modules
+        # that accumulate cross-unit batched constraints, e.g. the
+        # steam-cycle EoS kernel that evaluates IAPWS-95 for every
+        # registered stream state in ONE stacked call)
+        self._finalizers: List[Callable] = []
 
     # ---------------- variables / params ----------------
 
@@ -266,6 +271,10 @@ class Flowsheet:
     def compile(self, objective: Optional[Callable] = None, sense: str = "min"):
         from dispatches_tpu.core.compile import CompiledNLP
 
+        if self._finalizers:
+            for f in list(self._finalizers):
+                f(self)
+            self._finalizers.clear()
         return CompiledNLP(self, objective=objective, sense=sense)
 
 

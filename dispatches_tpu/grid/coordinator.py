@@ -58,12 +58,18 @@ class DoubleLoopCoordinator:
     # -- static params (reference :46-87) ------------------------------
 
     def update_static_params(self, gen_dict: Dict) -> None:
+        """Overlay the participant's model_data onto the market's
+        generator record (called by MarketSimulator at construction —
+        the role of the reference coordinator's extra RUC/SCED
+        callbacks).  Thermal marginal-cost bid pairs become a piecewise
+        cumulative cost curve under ``p_cost``."""
         md = self.bidder.bidding_model_object.model_data
         for param, value in md.to_dict().items():
             if param == "gen_name" or value is None:
                 continue
-            if param == "p_cost" and md.generator_type == "thermal":
-                gen_dict[param] = {
+            if (param == "production_cost_bid_pairs"
+                    and md.generator_type == "thermal"):
+                gen_dict["p_cost"] = {
                     "data_type": "cost_curve",
                     "cost_curve_type": "piecewise",
                     "values": convert_marginal_costs_to_actual_costs(value),
@@ -120,11 +126,8 @@ class DoubleLoopCoordinator:
         if self._hour_in_day >= 24 and self.tracker.implemented_stats:
             self._hour_in_day = 0
             profile = self.tracker.implemented_stats[-1]
-            try:
-                self.bidder.update_day_ahead_model(**profile)
-                self.bidder.update_real_time_model(**profile)
-            except TypeError:
-                pass
+            self.bidder.update_day_ahead_model(**profile)
+            self.bidder.update_real_time_model(**profile)
         return self.tracker.get_last_delivered_power()
 
     # -- results -------------------------------------------------------

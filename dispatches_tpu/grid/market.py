@@ -270,7 +270,7 @@ def solve_unit_commitment(
     Exact MILP via scipy/HiGHS branch-and-cut when ``use_milp`` (the
     host-side co-processing path); otherwise LP relaxation + rounding
     with a capacity-feasibility repair (the solver-free fallback)."""
-    from scipy.optimize import LinearConstraint, linprog, milp
+    from scipy.optimize import Bounds, LinearConstraint, linprog, milp
     from scipy.sparse import lil_matrix
 
     H = len(hours)
@@ -379,7 +379,7 @@ def solve_unit_commitment(
         res = milp(
             c,
             constraints=con,
-            bounds=__import__("scipy.optimize", fromlist=["Bounds"]).Bounds(lb, ub),
+            bounds=Bounds(lb, ub),
             integrality=integrality,
             options={"time_limit": 60.0},
         )
@@ -397,6 +397,11 @@ def solve_unit_commitment(
         bounds=list(zip(lb, ub)),
         method="highs",
     )
+    if res.x is None:
+        raise RuntimeError(
+            "unit commitment infeasible: LP relaxation has no solution "
+            f"(status {res.status}: {res.message})"
+        )
     u = res.x[: G * H].reshape(G, H).T
     u = (u >= 0.5).astype(float)
     # feasibility repair: commit cheapest-capacity units until pmax
@@ -674,9 +679,47 @@ class MarketSimulator:
         if coordinator is not None:
             pname = coordinator.generator_name
             pbus = coordinator.generator_bus(case)
+            self._apply_participant_params(coordinator)
         self._da_lp = _DispatchLP(case, self.ruc_horizon, pname, pbus)
         self._rt_lp = _DispatchLP(case, self.sced_horizon, pname, pbus)
         self._pname = pname
+
+    def _apply_participant_params(self, coordinator) -> None:
+        """Push the participant's static model_data into the market's
+        generator record (the reference coordinator's extra RUC/SCED
+        plugin callbacks, ``workflow/coordinator.py:29-44`` — there they
+        rewrite Prescient instance dicts; here they overlay the
+        matching ThermalUnit in the case before the LPs are built)."""
+        gen_dict: Dict = {}
+        coordinator.update_static_params(gen_dict)
+        name = coordinator.generator_name
+        for t in self.case.thermals:
+            if t.name != name:
+                continue
+            if "p_min" in gen_dict:
+                t.pmin = float(gen_dict["p_min"])
+            if "p_max" in gen_dict:
+                t.pmax = float(gen_dict["p_max"])
+            if "ramp_up_60min" in gen_dict:
+                t.ramp_hr = float(gen_dict["ramp_up_60min"])
+            if "min_up_time" in gen_dict:
+                t.min_up = float(gen_dict["min_up_time"])
+            if "min_down_time" in gen_dict:
+                t.min_down = float(gen_dict["min_down_time"])
+            curve = gen_dict.get("p_cost")
+            if curve and curve.get("values"):
+                pts = np.asarray(curve["values"], dtype=float)  # (k, 2)
+                if len(pts) >= 2:
+                    widths = np.diff(pts[:, 0])
+                    marg = np.diff(pts[:, 1]) / np.maximum(widths, 1e-9)
+                    k = min(N_SEG, len(widths))
+                    t.seg_mw = np.concatenate(
+                        [widths[:k], np.zeros(N_SEG - k)]
+                    )
+                    t.seg_cost = np.concatenate(
+                        [marg[:k], np.full(N_SEG - k, marg[k - 1])]
+                    )
+                    t.noload_cost = float(pts[0, 1])
 
     def simulate(self, start_date: str, num_days: int):
         case = self.case
@@ -722,8 +765,9 @@ class MarketSimulator:
                     {b: da_lmp[:24, i] for i, b in enumerate(case.buses)},
                 )
 
-            # ---- hourly SCED over the settlement day -------------
-            for hr in range(24):
+            # ---- hourly SCED over the settlement day (bounded by the
+            # RUC horizon when ruc_horizon < 24) -------------------
+            for hr in range(min(24, H)):
                 h_abs = d0 + hr
                 Hs = self.sced_horizon
                 sced_hours = np.clip(
@@ -771,10 +815,11 @@ class MarketSimulator:
                         "RenewablesCurtailment": round(
                             sum(
                                 max(
-                                    float(case.renewables[0].rt_cap[h_abs]) * 0,
-                                    0,
+                                    float(r.rt_cap[h_abs])
+                                    - float(sol_rt[f"ren_{i}"][0]),
+                                    0.0,
                                 )
-                                for _ in [0]
+                                for i, r in enumerate(self._rt_lp.rn)
                             ),
                             2,
                         ),

@@ -394,8 +394,8 @@ def sat_solve_P(P):
         ps2, _, _ = sat_solve_T(T + dT)
         dpdT = (ps2 - ps) / dT
         T = T - err / np.where(np.abs(dpdT) < 1e-300, 1e-300, dpdT)
-        if np.all(np.abs(err) < 1e-4 * P):
-            pass
+        if np.all(np.abs(err) < 1e-7 * P):
+            break
     ps, dl, dv = sat_solve_T(T)
     return T, dl, dv
 

@@ -1,0 +1,173 @@
+"""Damped-Newton solver for square (DOF = 0) flowsheet systems.
+
+The reference "simulates" a flowsheet by handing a square system to
+IPOPT (every ``initialize_build`` and e.g. the USC plant's
+``solver.solve(m)`` after ``build_plant_model`` —
+``ultra_supercritical_powerplant.py:1107,1324``).  An interior-point
+method is overkill there: no objective, no active inequalities — just
+F(x) = 0 with variable bounds that keep EoS auxiliaries on their
+declared branches.
+
+This module solves those systems with a projected damped Newton
+iteration, jit-compiled end-to-end:
+
+* Jacobian via ``jax.jacfwd`` of the scaled residual (one batched
+  forward-mode pass — compiles in a fraction of the IPM's
+  Lagrangian-Hessian program, which matters on small hosts and keeps
+  the TPU graph lean);
+* Armijo backtracking on  0.5 |F|^2  with step clipping into the bound
+  box (projection keeps branch-declared variables like liquid/vapor
+  reduced densities in their basins);
+* linear solves: LU on CPU; on TPU (no f64 LU kernel) a float32 LU
+  with float64 iterative refinement.
+
+Like the IPM, the compiled solver is a pure function of the params
+pytree, so a solved plant can be swept over operating points with
+``vmap`` (e.g. boiler flow / pressure sweeps, ``model_analysis``
+loops in the reference :1314-1328).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class NewtonOptions:
+    tol: float = 1e-8          # max|F| (scaled residuals) at convergence
+    max_iter: int = 50
+    armijo_c: float = 1e-4
+    backtrack: float = 0.5
+    max_backtracks: int = 25
+    # regularization added to J when the LU pivot fails / step explodes
+    reg: float = 0.0
+    linear_solver: str = "auto"  # auto | lu | refined_f32
+
+
+class NewtonResult(NamedTuple):
+    x: jnp.ndarray
+    converged: jnp.ndarray
+    iterations: jnp.ndarray
+    max_residual: jnp.ndarray
+
+    @property
+    def status(self):
+        return jnp.where(self.converged, 0, 2)
+
+
+def _linear_solve_refined(J, r):
+    """f32 LU + f64 iterative refinement (TPU path: no f64 LU kernel)."""
+    J32 = J.astype(jnp.float32)
+    lu, piv = jax.scipy.linalg.lu_factor(J32)
+
+    def solve32(b):
+        return jax.scipy.linalg.lu_solve(
+            (lu, piv), b.astype(jnp.float32)
+        ).astype(jnp.float64)
+
+    x = solve32(r)
+    for _ in range(3):
+        x = x + solve32(r - J @ x)
+    return x
+
+
+def make_newton_solver(nlp, options: Optional[NewtonOptions] = None):
+    """Compile a square-system Newton solver for a CompiledNLP with no
+    inequalities.  Returns ``solver(params, x0=None) -> NewtonResult``."""
+    opt = options or NewtonOptions()
+
+    probe = nlp.eq(jnp.asarray(nlp.x0), nlp.default_params())
+    n_eq = probe.shape[-1]
+    if n_eq != nlp.n:
+        raise ValueError(
+            f"square solver needs n_eq == n_var, got {n_eq} != {nlp.n} "
+            "(use the IPM for non-square systems)"
+        )
+
+    lb = jnp.asarray(nlp.lb)  # already in the scaled decision space
+    ub = jnp.asarray(nlp.ub)
+
+    solver_kind = opt.linear_solver
+    if solver_kind == "auto":
+        solver_kind = (
+            "refined_f32" if jax.default_backend() == "tpu" else "lu"
+        )
+    lin = (_linear_solve_refined if solver_kind == "refined_f32"
+           else lambda J, r: jnp.linalg.solve(J, r))
+
+    def solver(params, x0=None):
+        x = jnp.asarray(nlp.x0 if x0 is None else x0, jnp.float64)
+        x = jnp.clip(x, lb, ub)
+
+        def F(xx):
+            return nlp.eq(xx, params)
+
+        jac = jax.jacfwd(F)
+
+        def merit(xx):
+            r = F(xx)
+            return 0.5 * jnp.dot(r, r)
+
+        def body(state):
+            x, it, _ = state
+            r = F(x)
+            J = jac(x)
+            if opt.reg:
+                J = J + opt.reg * jnp.eye(nlp.n)
+            dx = lin(J, -r)
+            # guard non-finite steps (singular J): fall back to gradient
+            bad = ~jnp.all(jnp.isfinite(dx))
+            dx = jnp.where(bad, -(J.T @ r), dx)
+
+            m0 = 0.5 * jnp.dot(r, r)
+            g_dx = jnp.dot(J.T @ r, dx)
+
+            def ls_body(carry):
+                alpha, _, k = carry
+                return alpha * opt.backtrack, merit(
+                    jnp.clip(x + alpha * opt.backtrack * dx, lb, ub)
+                ), k + 1
+
+            def ls_cond(carry):
+                alpha, m_try, k = carry
+                return (m_try > m0 + opt.armijo_c * alpha * g_dx) & (
+                    k < opt.max_backtracks
+                )
+
+            m1 = merit(jnp.clip(x + dx, lb, ub))
+            alpha, _, _ = jax.lax.while_loop(
+                ls_cond, ls_body, (1.0, m1, 0)
+            )
+            x_new = jnp.clip(x + alpha * dx, lb, ub)
+            return x_new, it + 1, jnp.max(jnp.abs(F(x_new)))
+
+        def cond(state):
+            _, it, err = state
+            return (err > opt.tol) & (it < opt.max_iter)
+
+        x1, it, err = jax.lax.while_loop(
+            cond, body, (x, jnp.asarray(0), jnp.asarray(jnp.inf))
+        )
+        return NewtonResult(
+            x=x1,
+            converged=err <= opt.tol,
+            iterations=it,
+            max_residual=err,
+        )
+
+    return solver
+
+
+def solve_square(nlp, params=None, x0=None,
+                 options: Optional[NewtonOptions] = None, jit: bool = True):
+    """One-shot convenience wrapper (counterpart of ``solve_nlp``)."""
+    params = nlp.default_params() if params is None else params
+    solver = make_newton_solver(nlp, options)
+    if jit:
+        solver = jax.jit(solver)
+    return solver(params) if x0 is None else solver(params, jnp.asarray(x0))

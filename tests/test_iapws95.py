@@ -94,3 +94,35 @@ def test_molar_mass_consistency():
     pr310 = w95.props_tp(310.0, 101325.0, "liq")
     cp = (pr310["h"] - pr300["h"]) / 10.0
     assert cp == pytest.approx(75.3, rel=0.01)
+
+
+# ---------------------------------------------------------------------
+# Transport properties (IAPWS 2008 viscosity / 2011 conductivity)
+# ---------------------------------------------------------------------
+
+from dispatches_tpu.properties import iapws_transport as tr  # noqa: E402
+
+# (T [K], rho [kg/m3], mu [uPa s]) — 2008 release check table
+VISC_PTS = [
+    (298.15, 998.0, 889.735100), (298.15, 1200.0, 1437.649467),
+    (373.15, 1000.0, 307.883622), (433.15, 1.0, 14.538324),
+    (433.15, 1000.0, 217.685358), (873.15, 1.0, 32.619287),
+    (873.15, 100.0, 35.802262), (873.15, 600.0, 77.430195),
+    (1173.15, 1.0, 44.217245), (1173.15, 100.0, 47.640433),
+    (1173.15, 400.0, 64.154608),
+]
+# (T, rho, k [mW/m/K]) — 2011 release check table (no critical enh.)
+COND_PTS = [
+    (298.15, 0.0, 18.4341883), (298.15, 998.0, 607.712868),
+    (298.15, 1200.0, 799.038144), (873.15, 0.0, 79.1034659),
+]
+
+
+@pytest.mark.parametrize("T,rho,mu", VISC_PTS)
+def test_viscosity_points(T, rho, mu):
+    assert float(tr.visc_d(rho, T)) * 1e6 == pytest.approx(mu, rel=1e-6)
+
+
+@pytest.mark.parametrize("T,rho,k", COND_PTS)
+def test_conductivity_points(T, rho, k):
+    assert float(tr.therm_cond(rho, T)) * 1e3 == pytest.approx(k, rel=1e-6)
