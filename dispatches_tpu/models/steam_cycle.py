@@ -417,16 +417,39 @@ class SteamSplitter(UnitModel):
 
 
 class SteamMixer(UnitModel):
-    """Stream mixer with minimum-pressure momentum mixing (HelmMixer
-    counterpart, ``ultra_supercritical_powerplant.py:141-145,169-174,
-    198-202``)."""
+    """Stream mixer (HelmMixer counterpart,
+    ``ultra_supercritical_powerplant.py:141-145,169-174,198-202``).
+
+    ``momentum="minimize"`` gives the Helm smooth-minimum outlet pressure;
+    passing an inlet name instead pins the outlet pressure to that inlet
+    (the reference's ``momentum_mixing_type=none`` + explicit equality,
+    e.g. the integrated-storage recycle mixer,
+    ``integrated_storage...py:125-129,449-453``).
+
+    ``inlet_phases`` maps inlet names to their declared phase; inlets
+    whose temperature is never referenced build no EoS block, so the
+    declaration only matters for inlets used in temperature/entropy
+    expressions (condenser drains are "wet", extraction steam "vap").
+    """
 
     def __init__(self, fs: Flowsheet, name: str, inlet_list: List[str],
-                 outlet_phase: str = "liq"):
+                 outlet_phase: str = "liq",
+                 inlet_phases: Optional[Dict[str, str]] = None,
+                 momentum: str = "minimize"):
         super().__init__(fs, name)
         self.inlet_names = list(inlet_list)
+        phases = inlet_phases or {}
+        unknown = set(phases) - set(inlet_list)
+        if unknown:
+            raise ValueError(f"inlet_phases keys not in inlet_list: "
+                             f"{sorted(unknown)}")
+        bad = {nm: ph for nm, ph in phases.items()
+               if ph not in ("vap", "liq", "sc", "wet")}
+        if bad:
+            raise ValueError(f"invalid inlet phases: {bad}")
         self.inlet_states: Dict[str, SteamState] = {
-            nm: SteamState(self, nm, "vap") for nm in inlet_list
+            nm: SteamState(self, nm, phases.get(nm, "vap"))
+            for nm in inlet_list
         }
         self.outlet_state = SteamState(self, "outlet", outlet_phase)
         ins = list(self.inlet_states.values())
@@ -439,14 +462,24 @@ class SteamMixer(UnitModel):
                     lambda v, p: sum(v[s.flow_mol] * v[s.enth_mol] for s in ins)
                     - v[out.flow_mol] * v[out.enth_mol], scale=_SE)
 
-        def min_p(v):
-            m = v[ins[0].pressure]
-            for s in ins[1:]:
-                m = smooth_min(m, v[s.pressure])
-            return m
+        if momentum == "minimize":
+            def min_p(v):
+                m = v[ins[0].pressure]
+                for s in ins[1:]:
+                    m = smooth_min(m, v[s.pressure])
+                return m
 
-        self.add_eq("pressure_minimize",
-                    lambda v, p: v[out.pressure] - min_p(v), scale=_SP)
+            self.add_eq("pressure_minimize",
+                        lambda v, p: v[out.pressure] - min_p(v), scale=_SP)
+        else:
+            if momentum not in self.inlet_states:
+                raise ValueError(
+                    f"momentum must be 'minimize' or an inlet name, got "
+                    f"{momentum!r}")
+            ref = self.inlet_states[momentum]
+            self.add_eq("pressure_equality",
+                        lambda v, p: v[out.pressure] - v[ref.pressure],
+                        scale=_SP)
 
     def inlet(self, name: str):
         return self.inlet_states[name].port
