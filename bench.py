@@ -241,19 +241,149 @@ def main():
     solves_per_sec = N_SCENARIOS / batched_per_sweep
     speedup = serial_per_solve / (batched_per_sweep / N_SCENARIOS)
 
-    print(
-        json.dumps(
-            {
-                "metric": "pricetaker_24h_solves_per_sec_366batch",
-                "value": round(solves_per_sec, 2),
-                "unit": "solves/s",
-                "vs_baseline": round(speedup, 2),
-                "backend": backend,
-                "baseline": "serial scipy-HiGHS per scenario (IPOPT-class)",
-                "obj_rel_err_vs_highs": round(rel_err, 8),
+    out = {
+        "metric": "pricetaker_24h_solves_per_sec_366batch",
+        "value": round(solves_per_sec, 2),
+        "unit": "solves/s",
+        "vs_baseline": round(speedup, 2),
+        "backend": backend,
+        "baseline": "serial scipy-HiGHS per scenario (IPOPT-class)",
+        "obj_rel_err_vs_highs": round(rel_err, 8),
+    }
+
+    # extras only on the accelerator: the CPU fallback exists to always
+    # report a headline number quickly, not to grind PDHG on one core
+    deadline = time.monotonic() + (22 * 60 if backend != "cpu" else -1)
+
+    # ---- utilization evidence (VERDICT r2 weak #1): the 366-sweep is
+    # far below chip saturation — estimate the PDHG work rate and scale
+    # the batch until throughput flattens ----------------------------
+    try:
+        if time.monotonic() < deadline:
+            r366 = vsolve(
+                {"p": {"lmp": jnp.asarray(lmps[:N_SCENARIOS]),
+                       "wind_cap_cf": jnp.asarray(cfs[:N_SCENARIOS])},
+                 "fixed": params["fixed"]}
+            )
+            iters = float(np.mean(np.asarray(r366.iters)))
+            m_rows = int(nlp.m_eq + nlp.m_ineq)
+            # 2 matvecs (fwd + adjoint) x 2 flops/nnz per PDHG
+            # iteration, dense A of (m_rows x n)
+            flops_per_solve = iters * 4.0 * m_rows * nlp.n
+            gflops = flops_per_solve * solves_per_sec / 1e9
+            out["pdhg_iters_mean"] = round(iters, 1)
+            out["est_gflops_366batch"] = round(gflops, 2)
+    except Exception as exc:  # pragma: no cover - telemetry only
+        out["util_error"] = str(exc)[:120]
+
+    try:
+        peak_sps = solves_per_sec
+        for B in (1024, 4096):
+            if time.monotonic() > deadline:
+                break
+            lmps_b = np.tile(lmps, (B // N_SCENARIOS + 1, 1))[:B]
+            cfs_b = np.tile(cfs, (B // N_SCENARIOS + 1, 1))[:B]
+            sweep_b = make_sweep(B)
+            sweep_b(lmps_b, cfs_b)  # compile
+            t0 = time.perf_counter()
+            for _ in range(2):
+                sweep_b(lmps_b, cfs_b)
+            per = (time.perf_counter() - t0) / 2
+            sps = B / per
+            out[f"solves_per_sec_batch{B}"] = round(sps, 2)
+            peak_sps = max(peak_sps, sps)
+        out["solves_per_sec_peak"] = round(peak_sps, 2)
+        out["vs_baseline_peak"] = round(peak_sps * serial_per_solve, 2)
+    except Exception as exc:
+        out["batch_scaling_error"] = str(exc)[:120]
+
+    # ---- NLP workload (VERDICT r2 item 4c): fixed-design wind+battery
+    # +PEM price-taker re-solved across an LMP batch on the IPM -------
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.case_studies.renewables.wind_battery_pem_lmp \
+                import wind_battery_pem_optimize
+            from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+            Tn = 24
+            rng2 = np.random.default_rng(1)
+            base_lmp = 35.0 + 25.0 * np.sin(2 * np.pi * np.arange(Tn) / 24)
+            nlp_params = {
+                "wind_mw": 200.0, "batt_mw": 25.0, "pem_mw": 25.0,
+                "design_opt": False, "extant_wind": True,
+                "capacity_factors": 0.35
+                + 0.3 * rng2.random(Tn),
+                "DA_LMPs": base_lmp,
             }
-        )
-    )
+            r_pem = wind_battery_pem_optimize(Tn, nlp_params)
+            nlp2 = r_pem.nlp
+            B2 = 32
+            lmp_batch = (base_lmp[None, :]
+                         + 10.0 * rng2.standard_normal((B2, Tn))) * 1e-3
+            ipm = make_ipm_solver(nlp2, IPMOptions(max_iter=200))
+            p2 = nlp2.default_params()
+            vsolve2 = jax.jit(jax.vmap(
+                ipm, in_axes=({"p": {**{k: None for k in p2["p"]},
+                                     "lmp": 0},
+                               "fixed": None},)))
+            batched2 = {
+                "p": {**{k: jnp.asarray(v) for k, v in p2["p"].items()},
+                      "lmp": jnp.asarray(lmp_batch)},
+                "fixed": {k: jnp.asarray(v)
+                          for k, v in p2["fixed"].items()},
+            }
+            rr = vsolve2(batched2)  # compile + solve
+            t0 = time.perf_counter()
+            rr = vsolve2(batched2)
+            per = time.perf_counter() - t0
+            conv = float(np.mean(np.asarray(rr.converged)))
+            out["nlp_pem24h_solves_per_sec_batch32"] = round(B2 / per, 2)
+            out["nlp_pem24h_converged_frac"] = round(conv, 3)
+    except Exception as exc:
+        out["nlp_bench_error"] = str(exc)[:120]
+
+    # ---- long-horizon LP: one 8736-h annual wind+battery price-taker
+    # (the multiperiod "sequence length" axis, SURVEY.md §5) ----------
+    try:
+        if time.monotonic() < deadline:
+            T8 = 8736
+            fs8 = Flowsheet(horizon=T8)
+            fs8.add_var("wind_elec", lb=0, ub=1e6, scale=1e3)
+            fs8.add_var("grid", lb=0, ub=1e6, scale=1e3)
+            fs8.add_var("batt_in", lb=0, ub=1e6, scale=1e3)
+            fs8.add_var("batt_out", lb=0, ub=1e6, scale=1e3)
+            fs8.add_var("soc", lb=0, ub=4e6, scale=1e3)
+            fs8.add_var("soc0", shape=(), lb=0)
+            fs8.fix("soc0", 0.0)
+            rng3 = np.random.default_rng(2)
+            fs8.add_param("lmp", 0.02 + 0.015 * rng3.random(T8))
+            fs8.add_param("wind_cap_cf", 400e3 * (0.4 + 0.6 * rng3.random(T8)))
+            fs8.add_eq("power_balance",
+                       lambda v, p: v["wind_elec"] - v["grid"] - v["batt_in"])
+            fs8.add_eq("soc_evolution",
+                       lambda v, p: v["soc"] - tshift(v["soc"], v["soc0"])
+                       - 0.95 * v["batt_in"] + v["batt_out"] / 0.95)
+            fs8.add_ineq("wind_cf",
+                         lambda v, p: v["wind_elec"] - p["wind_cap_cf"])
+            fs8.add_ineq("batt_p_in", lambda v, p: v["batt_in"] - 300e3)
+            fs8.add_ineq("batt_p_out", lambda v, p: v["batt_out"] - 300e3)
+            nlp8 = fs8.compile(
+                objective=lambda v, p: jnp.sum(
+                    p["lmp"] * (v["grid"] + v["batt_out"])),
+                sense="max")
+            solver8 = jax.jit(make_pdlp_solver(
+                nlp8, PDLPOptions(tol=1e-5, dtype="float32")))
+            p8 = nlp8.default_params()
+            r8 = solver8(p8)  # compile + solve
+            t0 = time.perf_counter()
+            r8 = solver8(p8)
+            out["horizon8736_lp_seconds"] = round(
+                time.perf_counter() - t0, 3)
+            out["horizon8736_converged"] = bool(np.asarray(r8.converged))
+    except Exception as exc:
+        out["horizon8736_error"] = str(exc)[:120]
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -63,6 +63,7 @@ MOD_RTS_LMP = np.array([
 ])
 PREVIOUS_POWER_0 = 447.66                         # MW (:123)
 HOT_EMPTY_INITIAL = 1103053.48                    # kg (:112)
+OBJ_SCALE = 1e-3                                  # outer conditioning only
 
 
 def create_usc_model(pmin: Optional[float] = None,
@@ -189,12 +190,14 @@ class MultiPeriodUscModel:
         hot_inv = self._hot_inventory
 
         def objective(vb, p):
-            # reference `pricetaker...py:94-107` (scaling factors = 1)
+            # reference `pricetaker...py:94-107` (their scaling factors
+            # are 1; the 1e-3 here only conditions the outer trust
+            # region — reported objectives are unscaled)
             rev = jnp.sum(lmp * vb["net_power"][:, 0])
             cost = jnp.sum(
                 vb["operating_cost"] + vb["plant_fixed_operating_cost"]
                 + vb["plant_variable_operating_cost"]) / (365.0 * 24.0)
-            return rev - cost
+            return (rev - cost) * OBJ_SCALE
 
         def ramp_rows(vb, p):
             # ±60 MW/h on plant power, seeded by previous_power
@@ -250,7 +253,9 @@ class MultiPeriodUscModel:
     def solve(self, U0: Optional[np.ndarray] = None, maxiter: int = 300,
               verbose: int = 0):
         res = self.brs.solve(U0=U0, u_bounds=dict(U_BOUNDS),
-                             maxiter=maxiter, verbose=verbose)
+                             maxiter=maxiter, verbose=verbose,
+                             gtol=1e-6, xtol=1e-9)
+        res = res._replace(obj=res.obj / OBJ_SCALE)
         sol = self.brs.stack_solution(res.X, res.U)
         inv = np.asarray(self.initial_hot_inventory + 3600.0 * np.cumsum(
             sol["hxc.tube_inlet.flow_mass"][:, 0]

@@ -101,11 +101,13 @@ def wind_battery_model(
     return m
 
 
-def wind_battery_optimize(
-    n_time_points: int, input_params: dict, verbose: bool = False
-) -> PriceTakerResult:
-    """Reference ``wind_battery_optimize`` (:169-258): NPV-maximal design
-    of the battery (wind extant) against a DA LMP signal."""
+def wind_battery_pricetaker_nlp(n_time_points: int, input_params: dict,
+                                verbose: bool = False):
+    """Build + compile the price-taker NPV program WITHOUT solving —
+    the kernel the scenario sweep / sharded solvers batch over LMP
+    signals (also consumed by ``__graft_entry__`` and the multichip
+    validation).  Returns ``(m, nlp)``; the LMP signal is the
+    ``"lmp"`` param in $/kWh."""
     m = wind_battery_model(n_time_points, input_params, verbose)
     fs = m.fs
 
@@ -151,6 +153,21 @@ def wind_battery_optimize(
         return (-capex + lp.PA * annual_revenue) * 1e-5
 
     nlp = fs.compile(objective=objective, sense="max")
+    return m, nlp
+
+
+def wind_battery_optimize(
+    n_time_points: int, input_params: dict, verbose: bool = False
+) -> PriceTakerResult:
+    """Reference ``wind_battery_optimize`` (:169-258): NPV-maximal design
+    of the battery (wind extant) against a DA LMP signal."""
+    m, nlp = wind_battery_pricetaker_nlp(n_time_points, input_params, verbose)
+    fs = m.fs
+    lmps = np.asarray(input_params["DA_LMPs"][:n_time_points]) * 1e-3
+    wind_cap_cost = (0.0 if input_params.get("extant_wind", True)
+                     else lp.wind_cap_cost)
+    n_weeks = n_time_points / (7 * 24)
+
     res = solve_nlp(
         nlp,
         options=IPMOptions(

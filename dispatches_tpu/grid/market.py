@@ -672,6 +672,11 @@ class MarketSimulator:
         self.output_dir.mkdir(parents=True, exist_ok=True)
         self.sced_horizon = int(sced_horizon)
         self.ruc_horizon = int(ruc_horizon)
+        if self.ruc_horizon < 24:
+            raise ValueError(
+                "ruc_horizon must be >= 24: the settlement loop clears "
+                "24 hours per simulated day, so a shorter commitment "
+                "horizon would silently drop settlement hours")
         self.reserve_factor = float(reserve_factor)
         self.use_milp = use_milp
         self.coordinator = coordinator
@@ -707,7 +712,9 @@ class MarketSimulator:
             if "min_down_time" in gen_dict:
                 t.min_down = float(gen_dict["min_down_time"])
             curve = gen_dict.get("p_cost")
-            if curve and curve.get("values"):
+            # renewable participants carry a scalar p_cost; only a
+            # thermal piecewise dict contributes bid segments
+            if isinstance(curve, dict) and curve.get("values"):
                 pts = np.asarray(curve["values"], dtype=float)  # (k, 2)
                 if len(pts) >= 2:
                     widths = np.diff(pts[:, 0])

@@ -104,7 +104,7 @@ class _TubeSide:
     flow order (index 0 = first segment the fluid meets)."""
 
     def __init__(self, tes: "ConcreteTES", mode: str, P_in: float,
-                 shape, n_seg: int):
+                 shape):
         self.mode = mode
         self.sat = _SatConstants(P_in)
         u = tes
@@ -356,12 +356,27 @@ class ConcreteTES(UnitModel):
     # ------------------------------------------------------------------
 
     def fix_inlet(self, mode: str, flow_mol_total=None, enth_mol=None,
-                  temperature=None) -> None:
+                  temperature=None, pressure=None) -> None:
         """Fix a side's plant inlet (reference test pattern: fix
-        flow/pressure/enthalpy on the charge/discharge inlet port)."""
+        flow/pressure/enthalpy on the charge/discharge inlet port).
+
+        The in-tube EoS is tabulated at the side's DESIGN pressure
+        (``model_data`` inlet_pressure_*), so an off-design port
+        pressure would silently yield inconsistent thermodynamics —
+        a ``pressure`` more than 2% from the design value is rejected.
+        """
         fs = self.fs
         st: SteamState = getattr(self, f"inlet_{mode}_state")
         side: _TubeSide = getattr(self, mode)
+        if pressure is not None:
+            rel = abs(pressure - side.sat.P) / side.sat.P
+            if rel > 0.02:
+                raise ValueError(
+                    f"{mode} inlet pressure {pressure:.4g} Pa is "
+                    f"{rel:.1%} from the design pressure "
+                    f"{side.sat.P:.4g} Pa at which the in-tube EoS is "
+                    "evaluated; rebuild the TES with the new design "
+                    "pressure instead")
         if temperature is not None:
             branch = "vap" if temperature > side.sat.Tsat else "liq"
             enth_mol = float(
@@ -371,7 +386,7 @@ class ConcreteTES(UnitModel):
             fs.fix(st.flow_mol, flow_mol_total)
         if enth_mol is not None:
             fs.fix(st.enth_mol, enth_mol)
-        fs.fix(st.pressure, side.sat.P)
+        fs.fix(st.pressure, side.sat.P if pressure is None else pressure)
 
     def initialize(self) -> None:
         """Host-side warm start: march the explicit tube/wall cascade
@@ -418,8 +433,8 @@ class ConcreteTES(UnitModel):
             d_v = np.interp(h_hi, hv_g, dv_g)
             return T_l, d_l, T_v, d_v
 
-        # read fixed inlets
-        def fixed(name, default):
+        # read fixed inlets (fixed value, else the registered init)
+        def fixed(name):
             spec = fs.var_specs[self.v(name)]
             val = spec.fixed_value if spec.fixed else spec.init
             return np.broadcast_to(np.asarray(val, dtype=float), (T,)).copy()
@@ -442,9 +457,9 @@ class ConcreteTES(UnitModel):
         for mode, side, _ in sides:
             n_tubes = float(data["num_tubes"])
             st = getattr(self, f"inlet_{mode}_state")
-            f_tot = fixed(f"inlet_{mode}.flow_mol", 1.0)
+            f_tot = fixed(f"inlet_{mode}.flow_mol")
             f_tube[mode] = f_tot / n_tubes
-            h_in[mode] = fixed(f"inlet_{mode}.enth_mol", 3e4)
+            h_in[mode] = fixed(f"inlet_{mode}.enth_mol")
 
         w = wall0.copy()
         for p in range(Pn):

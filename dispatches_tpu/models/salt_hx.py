@@ -79,12 +79,26 @@ class HXGeometry:
         return math.log(self.dia_ratio)
 
 
+def salt_nusselt(salt_name: str, re, pr, pr_wall, mu_in, mu_out):
+    """Storage-fluid Nusselt correlations by fluid, as published and
+    used per-disjunct in the reference design models
+    (`charge_design...py`: solar salt 2019 App Energy 233-234 p126
+    :509-518; Hitec 2014 He et al Exp Therm Fl Sci 59 p9 :642-651;
+    Therminol-66 :784-790)."""
+    if salt_name == "hitec_salt":
+        return 1.61 * (re * pr * 0.009) ** 0.63 * (mu_in / mu_out) ** 0.25
+    if salt_name == "thermal_oil":
+        return 0.36 * re**0.55 * pr**0.33 * (pr / pr_wall) ** 0.14
+    # solar salt (default)
+    return 0.35 * re**0.6 * pr**0.4 * (pr / pr_wall) ** 0.25 * 2.0**0.2
+
+
 def film_coefficients(g: "HXGeometry", salt: LiquidPackage,
                       F_salt, T_salt_in, T_salt_out,
                       F_w_mol, rho_w_in, T_w_in, mu_w_out):
     """Salt- and water-side film coefficients from the reference's
-    Nusselt correlations (salt: 2019 App Energy 233-234 p126; steam:
-    2001 Zavoico — ``integrated_storage...py:206-281`` charge /
+    Nusselt correlations (salt: per-fluid, see :func:`salt_nusselt`;
+    steam: 2001 Zavoico — ``integrated_storage...py:206-281`` charge /
     ``:309-391`` discharge).  Pure function of scalars/arrays; shared by
     the in-graph residuals and the host-side initialization sweep."""
     mu_s, mu_sw = salt.visc_d(T_salt_in), salt.visc_d(T_salt_out)
@@ -93,7 +107,7 @@ def film_coefficients(g: "HXGeometry", salt: LiquidPackage,
     re_s = F_salt * g.tube_outer_dia / (g.shell_eff_area * mu_s)
     pr_s = cp_s * mu_s / k_s
     pr_sw = cp_sw * mu_sw / k_sw
-    nu_s = 0.35 * re_s**0.6 * pr_s**0.4 * (pr_s / pr_sw) ** 0.25 * 2.0**0.2
+    nu_s = salt_nusselt(salt.name, re_s, pr_s, pr_sw, mu_s, mu_sw)
     h_salt = k_s * nu_s / g.tube_outer_dia
 
     mu_w = wtr.visc_d(rho_w_in, T_w_in)
