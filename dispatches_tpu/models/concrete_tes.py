@@ -268,13 +268,13 @@ class ConcreteTES(UnitModel):
         sides = []
         if operating_mode in ("charge", "combined"):
             self.charge = _TubeSide(
-                self, "charge", data["inlet_pressure_charge"], (T, Pn, S), S
+                self, "charge", data["inlet_pressure_charge"], (T, Pn, S)
             )
             sides.append(("charge", self.charge, False))
         if operating_mode in ("discharge", "combined"):
             self.discharge = _TubeSide(
                 self, "discharge", data["inlet_pressure_discharge"],
-                (T, Pn, S), S,
+                (T, Pn, S),
             )
             sides.append(("discharge", self.discharge, True))
 

@@ -49,13 +49,12 @@ class SimulationData:
     def num_sims(self, value):
         if not isinstance(value, int):
             raise TypeError(
-                f"The number of simulation years must be a positive integer, "
-                f"but {type(value)} is given."
+                f"num_sims expects a positive int (simulation years); "
+                f"got {type(value).__name__}"
             )
         if value < 1:
             raise ValueError(
-                f"The number of simulation years must be a positive integer, "
-                f"but {value} is given."
+                f"num_sims expects a positive int (simulation years); got {value}"
             )
         self._num_sims = value
 
@@ -67,12 +66,11 @@ class SimulationData:
     def case_type(self, value):
         if not isinstance(value, str):
             raise TypeError(
-                f"The value of case_type must be str, but {type(value)} is given."
+                f"case_type expects a str; got {type(value).__name__}"
             )
         if value not in ("RE", "NE", "FE"):
             raise ValueError(
-                f"The case_type must be one of 'RE','NE' or 'FE', "
-                f"but {value} is given."
+                f"case_type must be 'RE', 'NE' or 'FE'; got {value!r}"
             )
         self._case_type = value
 
