@@ -107,6 +107,91 @@ def kmeans_fit(
     return np.asarray(centers), np.asarray(labels), float(jnp.sum(d2))
 
 
+def soft_dtw(x, y, gamma: float = 1.0):
+    """Soft-DTW divergence between two univariate series (Cuturi &
+    Blondel 2017) — the differentiable alignment metric behind
+    tslearn's ``metric='softdtw'`` option (reference
+    ``Time_Series_Clustering.py`` metric choices).  Quadratic local
+    cost; the classic DP with a soft-min, expressed as a double
+    ``lax.scan`` (anti-sequential in both axes; D=24 day-slices keep it
+    cheap)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    D = (x[:, None] - y[None, :]) ** 2
+    Ty = D.shape[1]
+    big = 1e10
+
+    def softmin3(a, b, c):
+        z = jnp.stack([a, b, c]) * (-1.0 / gamma)
+        return -gamma * jax.nn.logsumexp(z, axis=0)
+
+    def row_step(prev_row, d_row):
+        # prev_row = R[i-1, 0..Ty]; walk the row left-to-right
+        ups = prev_row[1:]       # R[i-1, j]
+        diags = prev_row[:-1]    # R[i-1, j-1]
+
+        def col_step(left, inp):
+            d, up, diag = inp
+            r = d + softmin3(up, diag, left)
+            return r, r
+
+        _, row = jax.lax.scan(col_step, big, (d_row, ups, diags))
+        return jnp.concatenate([jnp.full((1,), big), row]), None
+
+    R0 = jnp.concatenate([jnp.zeros(1), jnp.full((Ty,), big)])
+    Rlast, _ = jax.lax.scan(row_step, R0, D)
+    return Rlast[-1]
+
+
+def kmeans_fit_softdtw(
+    X: np.ndarray,
+    n_clusters: int,
+    gamma: float = 1.0,
+    seed: int = 42,
+    n_iter: int = 10,
+    barycenter_steps: int = 25,
+    barycenter_lr: float = 0.2,
+):
+    """Soft-DTW k-means on (N, D) day-slices: Euclidean k-means++ fit
+    seeds the centers (a standard warm start), then Lloyd iterations
+    under the soft-DTW divergence with GRADIENT barycenter updates —
+    soft-DTW is smooth, so the cluster barycenter is found by descending
+    ``sum_i soft_dtw(center, x_i)`` with ``jax.grad`` (the role of
+    tslearn's L-BFGS soft-DTW barycenter).  Returns
+    (centers, labels, inertia)."""
+    X = jnp.asarray(X, jnp.float64)
+    centers, _, _ = kmeans_fit(np.asarray(X), n_clusters, seed=seed)
+    centers = jnp.asarray(centers)
+
+    pair = jax.vmap(jax.vmap(soft_dtw, (None, 0, None)), (0, None, None))
+
+    def loss(c, w):
+        # weighted mean soft-DTW from one center to every sample
+        d = jax.vmap(soft_dtw, (None, 0, None))(c, X, gamma)
+        return jnp.sum(w * d) / jnp.maximum(jnp.sum(w), 1.0)
+
+    grad = jax.jit(jax.grad(loss))
+    dists_fn = jax.jit(lambda cs: pair(X, cs, gamma))
+
+    for _ in range(n_iter):
+        d = dists_fn(centers)                     # (N, k)
+        labels = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(labels, n_clusters, dtype=X.dtype)
+        new_centers = []
+        for c in range(n_clusters):
+            w = onehot[:, c]
+            ck = centers[c]
+            for _ in range(barycenter_steps):
+                ck = ck - barycenter_lr * grad(ck, w)
+            new_centers.append(ck)
+        centers = jnp.stack(new_centers)
+
+    d = dists_fn(centers)
+    labels = jnp.argmin(d, axis=1)
+    inertia = float(jnp.sum(jnp.min(d, axis=1)))
+    return np.asarray(centers), np.asarray(labels), inertia
+
+
 class TimeSeriesClustering:
     def __init__(self, num_clusters, simulation_data, filter_opt=True, metric="euclidean"):
         self.simulation_data = simulation_data
@@ -174,15 +259,15 @@ class TimeSeriesClustering:
     # -- clustering (reference :366-386) ------------------------------
 
     def clustering_data(self, wind_file=None):
-        if self.metric == "dtw":
-            raise NotImplementedError(
-                "soft-DTW metric is not implemented; use 'euclidean' "
-                "(the reference's tests and trained artifacts use euclidean)"
-            )
         train = self._transform_data(wind_file)
-        centers, labels, inertia = kmeans_fit(
-            train, self.num_clusters, seed=42
-        )
+        if self.metric == "dtw":
+            centers, labels, inertia = kmeans_fit_softdtw(
+                train, self.num_clusters, seed=42
+            )
+        else:
+            centers, labels, inertia = kmeans_fit(
+                train, self.num_clusters, seed=42
+            )
         return {
             "n_clusters": self.num_clusters,
             "cluster_centers_": centers,

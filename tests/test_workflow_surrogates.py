@@ -174,3 +174,30 @@ def test_managed_workflow(tmp_path):
     with pytest.raises(FileNotFoundError):
         DatasetFactory("rts-gmlc").create(path=str(tmp_path / "missing"))
     assert "directory" in str(ds)
+
+
+def test_soft_dtw_metric():
+    """soft-DTW k-means (reference Time_Series_Clustering metric
+    'softdtw'): alignment-aware distances and two-group separation."""
+    import numpy as np
+    from dispatches_tpu.workflow.clustering import (
+        kmeans_fit_softdtw,
+        soft_dtw,
+    )
+
+    x = np.sin(np.linspace(0, 2 * np.pi, 24))
+    y = np.roll(x, 3)
+    z = np.full(24, 0.2)
+    dxx, dxy, dxz = (float(soft_dtw(x, s)) for s in (x, y, z))
+    # self < time-shifted copy < unrelated flat profile
+    assert dxx < dxy < dxz
+
+    rng = np.random.default_rng(0)
+    X = np.vstack([
+        x[None, :] + 0.05 * rng.standard_normal((8, 24)),
+        z[None, :] + 0.05 * rng.standard_normal((8, 24)),
+    ])
+    _, labels, _ = kmeans_fit_softdtw(X, 2, n_iter=4, barycenter_steps=8)
+    assert len(set(labels[:8])) == 1
+    assert len(set(labels[8:])) == 1
+    assert labels[0] != labels[8]
