@@ -108,12 +108,15 @@ def kmeans_fit(
 
 
 def soft_dtw(x, y, gamma: float = 1.0):
-    """Soft-DTW divergence between two univariate series (Cuturi &
+    """Raw soft-DTW value between two univariate series (Cuturi &
     Blondel 2017) — the differentiable alignment metric behind
     tslearn's ``metric='softdtw'`` option (reference
-    ``Time_Series_Clustering.py`` metric choices).  Quadratic local
-    cost; the classic DP with a soft-min, expressed as a double
-    ``lax.scan`` (anti-sequential in both axes; D=24 day-slices keep it
+    ``Time_Series_Clustering.py`` metric choices).  NOTE the raw value
+    is not a divergence (``soft_dtw(x, x) < 0`` in general); the
+    clustering distances use the normalized form
+    ``sdtw(x,y) - (sdtw(x,x) + sdtw(y,y))/2``, which is zero at
+    identity.  Quadratic local cost; the classic DP with a soft-min,
+    expressed as a double ``lax.scan`` (D=24 day-slices keep it
     cheap)."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -151,42 +154,60 @@ def kmeans_fit_softdtw(
     n_iter: int = 10,
     barycenter_steps: int = 25,
     barycenter_lr: float = 0.2,
+    block: Optional[int] = None,
 ):
     """Soft-DTW k-means on (N, D) day-slices: Euclidean k-means++ fit
     seeds the centers (a standard warm start), then Lloyd iterations
-    under the soft-DTW divergence with GRADIENT barycenter updates —
-    soft-DTW is smooth, so the cluster barycenter is found by descending
-    ``sum_i soft_dtw(center, x_i)`` with ``jax.grad`` (the role of
-    tslearn's L-BFGS soft-DTW barycenter).  Returns
-    (centers, labels, inertia)."""
+    under the soft-DTW DIVERGENCE (normalized so d(x,x)=0, keeping the
+    inertia non-negative like the Euclidean path) with GRADIENT
+    barycenter updates — soft-DTW is smooth, so all k cluster
+    barycenters descend ``sum_i w_i sdtw(center, x_i)`` together under
+    one ``vmap`` of ``jax.grad`` (the role of tslearn's L-BFGS soft-DTW
+    barycenter).  ``block`` aligns each length-``block`` segment
+    independently and sums (for concatenated features like the RE
+    dispatch||wind day vectors, where warping across the boundary would
+    be meaningless).  Returns (centers, labels, inertia)."""
+    centers0, _, _ = kmeans_fit(X, n_clusters, seed=seed)
     X = jnp.asarray(X, jnp.float64)
-    centers, _, _ = kmeans_fit(np.asarray(X), n_clusters, seed=seed)
-    centers = jnp.asarray(centers)
+    centers = jnp.asarray(centers0)
 
-    pair = jax.vmap(jax.vmap(soft_dtw, (None, 0, None)), (0, None, None))
+    def sdtw(a, b):
+        if block is None or a.shape[0] <= block:
+            return soft_dtw(a, b, gamma)
+        nb = a.shape[0] // block
+        ar = a[: nb * block].reshape(nb, block)
+        br = b[: nb * block].reshape(nb, block)
+        return jnp.sum(jax.vmap(soft_dtw, (0, 0, None))(ar, br, gamma))
 
-    def loss(c, w):
-        # weighted mean soft-DTW from one center to every sample
-        d = jax.vmap(soft_dtw, (None, 0, None))(c, X, gamma)
-        return jnp.sum(w * d) / jnp.maximum(jnp.sum(w), 1.0)
+    self_fn = jax.jit(jax.vmap(lambda a: sdtw(a, a)))
+    X_self = self_fn(X)                                  # (N,)
 
-    grad = jax.jit(jax.grad(loss))
-    dists_fn = jax.jit(lambda cs: pair(X, cs, gamma))
+    def dists(cs, cs_self):
+        raw = jax.vmap(jax.vmap(sdtw, (None, 0)), (0, None))(X, cs)
+        return raw - 0.5 * (X_self[:, None] + cs_self[None, :])
+
+    dists_fn = jax.jit(dists)
+
+    def bary_step(cs, onehotT):
+        # one gradient step for ALL centers at once: (k, D) x (k, N)
+        def loss(c, w):
+            d = jax.vmap(sdtw, (None, 0))(c, X)
+            return jnp.sum(w * d) / jnp.maximum(jnp.sum(w), 1.0)
+
+        g = jax.vmap(jax.grad(loss))(cs, onehotT)
+        return cs - barycenter_lr * g
+
+    bary_fn = jax.jit(
+        lambda cs, oh: jax.lax.fori_loop(
+            0, barycenter_steps, lambda _, c: bary_step(c, oh), cs))
 
     for _ in range(n_iter):
-        d = dists_fn(centers)                     # (N, k)
+        d = dists_fn(centers, self_fn(centers))          # (N, k)
         labels = jnp.argmin(d, axis=1)
         onehot = jax.nn.one_hot(labels, n_clusters, dtype=X.dtype)
-        new_centers = []
-        for c in range(n_clusters):
-            w = onehot[:, c]
-            ck = centers[c]
-            for _ in range(barycenter_steps):
-                ck = ck - barycenter_lr * grad(ck, w)
-            new_centers.append(ck)
-        centers = jnp.stack(new_centers)
+        centers = bary_fn(centers, onehot.T)
 
-    d = dists_fn(centers)
+    d = dists_fn(centers, self_fn(centers))
     labels = jnp.argmin(d, axis=1)
     inertia = float(jnp.sum(jnp.min(d, axis=1)))
     return np.asarray(centers), np.asarray(labels), inertia
@@ -261,8 +282,11 @@ class TimeSeriesClustering:
     def clustering_data(self, wind_file=None):
         train = self._transform_data(wind_file)
         if self.metric == "dtw":
+            # RE concatenated features (24h dispatch || 24h wind) align
+            # per 24-h block — no warping across the boundary
             centers, labels, inertia = kmeans_fit_softdtw(
-                train, self.num_clusters, seed=42
+                train, self.num_clusters, seed=42,
+                block=24 if train.shape[1] > 24 else None,
             )
         else:
             centers, labels, inertia = kmeans_fit(
