@@ -1,0 +1,24 @@
+"""Sphinx configuration for dispatches_tpu (capability counterpart of
+the reference's ``docs/conf.py``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(".."))
+
+project = "dispatches-tpu"
+copyright = "2026, dispatches-tpu developers"
+author = "dispatches-tpu developers"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+templates_path = []
+exclude_patterns = ["_build"]
+html_theme = "alabaster"
+
+# heavy/optional imports that autodoc should not require at build time
+autodoc_mock_imports = ["jax", "jaxlib", "pandas", "scipy", "h5py"]
