@@ -3,19 +3,28 @@
 North-star metric (BASELINE.json): throughput of 24-h wind+battery
 price-taker solves across an LMP-scenario batch — the workload the
 reference runs as one serial CBC/IPOPT subprocess per scenario
-(``wind_battery_LMP.py:255``, SURVEY.md §3.1).  The baseline
-denominator is an IPOPT-class serial CPU loop: scipy's HiGHS solving
-the identical LP one scenario at a time (the reference's serial
-pattern; HiGHS is if anything *faster* than IPOPT on LPs, so the
-reported speedup is conservative).  The headline value is batched
-solves/second on the accelerator; ``vs_baseline`` = speedup over that
-serial CPU loop per BASELINE.md's >=50x north star.
+(``wind_battery_LMP.py:255``, SURVEY.md §3.1).  The solved model is the
+PRODUCTION flowsheet of ``case_studies/renewables`` (Wind_Power +
+ElectricalSplitter + BatteryStorage over 24 h, periodic SoC,
+degradation-linked capacity fade, NPV objective) — the same NLP
+``__graft_entry__`` compiles, NOT an inline toy (VERDICT r3 weak #2).
 
-Robustness: the TPU tunnel ("axon" backend) is known-flaky at snapshot
-time.  Backend liveness is probed in a subprocess with bounded retries;
-if the accelerator never comes up, the benchmark falls back to CPU and
-still reports a number (tagged via the "backend" key) rather than
-crashing with rc=1 (VERDICT round 1, weak #1).
+The baseline denominator is an IPOPT-class serial CPU loop: scipy's
+HiGHS solving the same formulation one scenario at a time, assembled
+INDEPENDENTLY from the reference model equations
+(``wind_battery_LMP.py:169-258``, ``battery.py:145-165``) so the
+objective cross-check is not circular.  The headline value is
+peak-batch solves/second on the accelerator; ``vs_baseline`` = speedup
+over the serial CPU loop per BASELINE.md's >=50x north star.
+
+Robustness (VERDICT r3 weak #1): the TPU tunnel ("axon" backend) is
+known-flaky and HANGS rather than erroring when down.  The benchmark
+therefore runs as a two-process harness: the parent probes backend
+liveness in subprocesses with exponential backoff (~15 min budget),
+then runs the measurement in a CHILD process with a hard timeout and
+one retry; only if the accelerator never comes up does it fall back to
+a CPU child, still reporting a number (tagged via "backend") rather
+than crashing.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -30,180 +39,184 @@ import time
 
 import numpy as np
 
+T = 24
+N_SCENARIOS = 366  # the annual-sweep batch (SURVEY.md §2.7)
+PEAK_BATCHES = (1024, 4096)
+CHILD_ENV = "DISPATCHES_BENCH_CHILD"
 
-def _probe_backend(retries: int = 3, wait_s: float = 10.0) -> bool:
-    """Return True iff a (non-CPU) JAX backend initializes in a fresh
-    subprocess.  Probing in a subprocess keeps a failed init from being
-    cached in this process, so a later retry can genuinely succeed.
-    A downed tunnel HANGS device init rather than erroring (observed),
-    so the probe timeout is kept short — worst case ~3.5 min before the
-    CPU fallback kicks in."""
-    code = (
-        "import jax; ds = jax.devices(); "
-        "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)"
-    )
-    for attempt in range(retries):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                timeout=60,
-            )
-            if r.returncode == 0:
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt < retries - 1:
-            time.sleep(wait_s)
-    return False
+WIND_MW = 200.0
+BATT_MW = 25.0
 
 
-def _serial_highs_baseline(T, lmps, cfs, n_serial):
-    """IPOPT-class serial baseline: the same 24-h wind+battery LP solved
-    one scenario at a time with scipy/HiGHS on the host CPU.
+def _scenarios(n, rng=None):
+    """LMP ($/MWh) and wind capacity-factor batches for n scenarios."""
+    rng = rng or np.random.default_rng(0)
+    lmps = 35.0 + 25.0 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (n, 1))) / 24
+    ) + 5.0 * rng.standard_normal((n, T))
+    lmps = np.clip(lmps, 0.0, 200.0)  # reference price cap
+    cfs = np.clip(0.35 + 0.3 * rng.random((n, T)), 0.0, 1.0)
+    return lmps, cfs
 
-    The LP is assembled INDEPENDENTLY of the Flowsheet lowering on
-    purpose: the obj_rel_err_vs_highs cross-check would be circular if
-    the baseline reused make_lp_data's extracted matrices.  Keep the
-    coefficients in sync with the flowsheet built in main().
 
-    Variable layout per scenario: x = [wind_elec, grid, batt_in,
-    batt_out, soc] each of length T.  Equalities: power balance,
-    SoC evolution (with soc0 = 0), periodic SoC.  The capacity-factor
-    and battery power limits are plain variable bounds in LP form.
-    Returns (seconds_per_solve, objectives)."""
+# ---------------------------------------------------------------------
+# serial CPU baseline (independent LP assembly)
+# ---------------------------------------------------------------------
+
+def _serial_highs_baseline(lmps, cfs, n_serial):
+    """IPOPT-class serial baseline: the 24-h wind+battery price-taker
+    solved one scenario at a time with scipy/HiGHS on the host CPU.
+
+    The LP is assembled INDEPENDENTLY of the Flowsheet lowering, from
+    the reference equations: splitter balance (``elec_splitter.py:
+    115-117``), SoC evolution / throughput / degradation fade
+    (``battery.py:145-157``), wind CF limit (``wind_power.py:120-122``),
+    periodic SoC and the NPV profit terms (``wind_battery_LMP.py:
+    219-253``).  Returns (seconds_per_solve, scaled_npv_objectives).
+    """
     from scipy.optimize import linprog
     from scipy.sparse import lil_matrix
 
-    n = 5 * T
-    iw, ig, ibi, ibo, isoc = (slice(k * T, (k + 1) * T) for k in range(5))
+    from dispatches_tpu.case_studies.renewables import load_parameters as lp
 
-    A = lil_matrix((2 * T + 1, n))
-    b = np.zeros(2 * T + 1)
+    P = BATT_MW * 1e3          # battery nameplate power, kW
+    E = 4.0 * P                # 4-hour duration (RE_flowsheet.py:154-155)
+    cap = WIND_MW * 1e3        # wind system capacity, kW
+    deg = 1e-4                 # battery degradation rate
+    eta = 0.95
+
+    # x = [wind, grid, batt_in, batt_out, soc, thru] each length T
+    n = 6 * T
+    iw, ig, ibi, ibo, isoc, ith = (slice(k * T, (k + 1) * T)
+                                   for k in range(6))
+
+    A = lil_matrix((3 * T + 1, n))
+    b = np.zeros(3 * T + 1)
     for t in range(T):
-        # power balance: wind - grid - batt_in = 0
+        # splitter: wind - grid - batt_in = 0
         A[t, iw.start + t] = 1.0
         A[t, ig.start + t] = -1.0
         A[t, ibi.start + t] = -1.0
-        # soc evolution: soc_t - soc_{t-1} - 0.95 batt_in + batt_out/0.95 = 0
+        # soc evolution (soc0 = 0, dt = 1 h)
         A[T + t, isoc.start + t] = 1.0
         if t > 0:
             A[T + t, isoc.start + t - 1] = -1.0
-        A[T + t, ibi.start + t] = -0.95
-        A[T + t, ibo.start + t] = 1.0 / 0.95
-    A[2 * T, isoc.stop - 1] = 1.0  # periodic: soc[-1] = soc0 = 0
+        A[T + t, ibi.start + t] = -eta
+        A[T + t, ibo.start + t] = 1.0 / eta
+        # throughput accumulation (thru0 = 0)
+        A[2 * T + t, ith.start + t] = 1.0
+        if t > 0:
+            A[2 * T + t, ith.start + t - 1] = -1.0
+        A[2 * T + t, ibi.start + t] = -0.5
+        A[2 * T + t, ibo.start + t] = -0.5
+    A[3 * T, isoc.stop - 1] = 1.0  # periodic: soc[-1] = soc0 = 0
     A = A.tocsr()
+
+    # degradation-linked capacity fade: soc_t + deg*thru_t <= E
+    Au = lil_matrix((T, n))
+    bu = np.full(T, E)
+    for t in range(T):
+        Au[t, isoc.start + t] = 1.0
+        Au[t, ith.start + t] = deg
+    Au = Au.tocsr()
+
+    n_weeks = T / (7 * 24)
+    ann = 52.0 / n_weeks
+    wind_om = cap * lp.wind_op_cost / 8760 * T
+    capex = lp.batt_cap_cost * P
 
     t0 = time.perf_counter()
     objs = []
     for i in range(n_serial):
+        lmp = lmps[i] * 1e-3  # $/kWh
         c = np.zeros(n)
-        c[ig] = -lmps[i]
-        c[ibo] = -lmps[i]
+        c[ig] = -lmp
+        c[ibo] = -lmp
+        c[ith.stop - 1] = lp.batt_rep_cost_kwh * deg
         bounds = (
-            [(0.0, cfs[i][t]) for t in range(T)]
-            + [(0.0, 1e6)] * T
-            + [(0.0, 300e3)] * T
-            + [(0.0, 300e3)] * T
-            + [(0.0, 4e6)] * T
+            [(0.0, cap * cfs[i][t]) for t in range(T)]   # wind CF limit
+            + [(0.0, None)] * T                           # grid
+            + [(0.0, P)] * T                              # batt_in
+            + [(0.0, P)] * T                              # batt_out
+            + [(0.0, E)] * T                              # soc
+            + [(0.0, None)] * T                           # throughput
         )
-        res = linprog(c, A_eq=A, b_eq=b, bounds=bounds, method="highs")
+        res = linprog(c, A_eq=A, b_eq=b, A_ub=Au, b_ub=bu, bounds=bounds,
+                      method="highs")
         assert res.status == 0, f"HiGHS baseline failed: {res.message}"
-        objs.append(-res.fun)
+        # same scaled-NPV scalar the compiled objective returns
+        rev = float(lmp @ (res.x[ig] + res.x[ibo]))
+        batt_var = lp.batt_rep_cost_kwh * deg * float(res.x[ith.stop - 1])
+        annual = (rev - wind_om - batt_var) * ann
+        objs.append((-capex + lp.PA * annual) * 1e-5)
     per_solve = (time.perf_counter() - t0) / n_serial
     return per_solve, np.array(objs)
 
 
-def main():
-    backend_ok = _probe_backend()
+# ---------------------------------------------------------------------
+# child: the actual measurement
+# ---------------------------------------------------------------------
 
+def run_bench():
     import jax
 
-    if not backend_ok:
+    if os.environ.get("DISPATCHES_BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
-    try:
-        # Residual risk: a tunnel that drops in the seconds between the
-        # successful probe and this init HANGS rather than raising (a
-        # hang cannot be interrupted in-process); the probe immediately
-        # precedes this call to keep that window minimal.
-        backend = jax.devices()[0].platform
-    except Exception:
-        # probe passed but init errored — force CPU so the benchmark
-        # still reports a number (rc=0)
-        jax.config.update("jax_platforms", "cpu")
-        backend = jax.devices()[0].platform
+    backend = jax.devices()[0].platform
 
-    from dispatches_tpu import Flowsheet
-    from dispatches_tpu.core.graph import tshift
     import jax.numpy as jnp
+
+    from dispatches_tpu.case_studies.renewables.wind_battery_lmp import (
+        wind_battery_pricetaker_nlp,
+    )
     from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
 
-    T = 24
-    N_SCENARIOS = 366  # the annual-sweep batch (SURVEY.md §2.7)
+    lmps, cfs = _scenarios(N_SCENARIOS)
 
-    fs = Flowsheet(horizon=T)
-    fs.add_var("wind_elec", lb=0, ub=1e6, scale=1e3)
-    fs.add_var("grid", lb=0, ub=1e6, scale=1e3)
-    fs.add_var("batt_in", lb=0, ub=1e6, scale=1e3)
-    fs.add_var("batt_out", lb=0, ub=1e6, scale=1e3)
-    fs.add_var("soc", lb=0, ub=4e6, scale=1e3)
-    fs.add_var("soc0", shape=(), lb=0)
-    fs.fix("soc0", 0.0)
-    fs.add_param("lmp", np.full(T, 0.02))
-    fs.add_param("wind_cap_cf", np.full(T, 400e3))
-    fs.add_eq(
-        "power_balance",
-        lambda v, p: v["wind_elec"] - v["grid"] - v["batt_in"],
-    )
-    fs.add_eq(
-        "soc_evolution",
-        lambda v, p: v["soc"]
-        - tshift(v["soc"], v["soc0"])
-        - 0.95 * v["batt_in"]
-        + v["batt_out"] / 0.95,
-    )
-    fs.add_ineq("wind_cf", lambda v, p: v["wind_elec"] - p["wind_cap_cf"])
-    fs.add_ineq("batt_p_in", lambda v, p: v["batt_in"] - 300e3)
-    fs.add_ineq("batt_p_out", lambda v, p: v["batt_out"] - 300e3)
-    fs.add_eq("periodic", lambda v, p: v["soc"][-1] - v["soc0"])
-    nlp = fs.compile(
-        objective=lambda v, p: jnp.sum(p["lmp"] * (v["grid"] + v["batt_out"])),
-        sense="max",
-    )
+    # ---- the PRODUCTION price-taker (same build as __graft_entry__) --
+    params_in = {
+        "wind_mw": WIND_MW,
+        "batt_mw": BATT_MW,
+        "design_opt": False,
+        "extant_wind": True,
+        "capacity_factors": cfs[0],
+        "DA_LMPs": lmps[0],
+    }
+    _, nlp = wind_battery_pricetaker_nlp(T, params_in)
 
-    # The LP fast path: restarted PDHG in float32 — the TPU-native solver
+    # LP fast path: restarted PDHG in float32 — the TPU-native solver
     # (f64 is software-emulated on TPU and ~90x slower; see pdlp.py).
     solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-5, dtype="float32"))
 
-    rng = np.random.default_rng(0)
-    lmps = 0.02 + 0.015 * np.sin(
-        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (N_SCENARIOS, 1)))
-        / 24
-    )
-    cfs = 400e3 * (0.4 + 0.6 * rng.random((N_SCENARIOS, T)))
-
     params = nlp.default_params()
-    in_axes = ({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},)
+    p_axes = {k: (0 if k in ("lmp", "windpower.capacity_factor") else None)
+              for k in params["p"]}
+    in_axes = ({"p": p_axes, "fixed": None},)
     vsolve = jax.jit(jax.vmap(solver, in_axes=in_axes))
+
+    def batched_params(lmp_b, cf_b):
+        return {
+            "p": {**params["p"], "lmp": jnp.asarray(lmp_b * 1e-3),
+                  "windpower.capacity_factor": jnp.asarray(cf_b)},
+            "fixed": params["fixed"],
+        }
 
     # The axon tunnel faults on very large single programs (observed
     # with the f64 IPM: 366-wide vmap => "TPU device error", 32-wide
-    # fine; the smaller PDLP program runs full-width).  Try the full
-    # batch first and fall back to fixed-shape chunked dispatch.
+    # fine).  Try the full batch first, fall back to fixed-shape
+    # chunked dispatch.
     def make_sweep(chunk):
-        def sweep(lmps, cfs):
+        def sweep(lmps_, cfs_):
             objs = []
-            for s in range(0, len(lmps), chunk):
-                lc, cc = lmps[s : s + chunk], cfs[s : s + chunk]
-                if len(lc) < chunk:  # pad tail chunk to the compiled shape
+            for s in range(0, len(lmps_), chunk):
+                lc, cc = lmps_[s:s + chunk], cfs_[s:s + chunk]
+                if len(lc) < chunk:  # pad tail to the compiled shape
                     pad = chunk - len(lc)
                     lc = np.concatenate([lc, np.repeat(lc[-1:], pad, 0)])
                     cc = np.concatenate([cc, np.repeat(cc[-1:], pad, 0)])
-                r = vsolve(
-                    {"p": {"lmp": lc, "wind_cap_cf": cc}, "fixed": params["fixed"]}
-                )
+                r = vsolve(batched_params(lc, cc))
                 objs.append(np.asarray(r.obj))
-            return np.concatenate(objs)[: len(lmps)]
+            return np.concatenate(objs)[: len(lmps_)]
 
         return sweep
 
@@ -212,178 +225,245 @@ def main():
     for chunk in (N_SCENARIOS, 128, 32):
         try:
             sweep = make_sweep(chunk)
-            all_objs = sweep(lmps, cfs)  # also warms up the compile
+            all_objs = sweep(lmps, cfs)  # warms the compile too
             break
         except Exception as exc:  # tunnel faults on large programs
             sweep = None
             last_exc = exc
     if sweep is None:
-        raise RuntimeError(
-            "all chunk sizes failed on this backend"
-        ) from last_exc
+        raise RuntimeError("all chunk sizes failed on this backend") from last_exc
 
-    # IPOPT-class serial baseline on the host CPU (HiGHS per scenario,
-    # the reference's one-subprocess-per-solve pattern) + objective
-    # cross-check so the speedup compares equal work.
+    # serial CPU baseline + objective cross-check (equal work)
     n_serial = 16
-    serial_per_solve, ref_objs = _serial_highs_baseline(T, lmps, cfs, n_serial)
-    ipm_objs = all_objs[:n_serial]
-    rel_err = float(
-        np.max(np.abs(ipm_objs - ref_objs) / np.maximum(np.abs(ref_objs), 1.0))
-    )
+    serial_per_solve, ref_objs = _serial_highs_baseline(lmps, cfs, n_serial)
+    rel_err = float(np.max(np.abs(all_objs[:n_serial] - ref_objs)
+                           / np.maximum(np.abs(ref_objs), 1.0)))
 
-    # batched throughput
+    # 366-batch throughput
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
         sweep(lmps, cfs)
-    batched_per_sweep = (time.perf_counter() - t0) / reps
-    solves_per_sec = N_SCENARIOS / batched_per_sweep
-    speedup = serial_per_solve / (batched_per_sweep / N_SCENARIOS)
+    per_sweep = (time.perf_counter() - t0) / reps
+    sps_366 = N_SCENARIOS / per_sweep
 
     out = {
-        "metric": "pricetaker_24h_solves_per_sec_366batch",
-        "value": round(solves_per_sec, 2),
-        "unit": "solves/s",
-        "vs_baseline": round(speedup, 2),
         "backend": backend,
-        "baseline": "serial scipy-HiGHS per scenario (IPOPT-class)",
+        "baseline": "serial scipy-HiGHS per scenario (IPOPT-class), "
+                    "independent reference-formulation assembly",
+        "model": "wind+battery 24h price-taker (production flowsheet, "
+                 f"n={nlp.n})",
         "obj_rel_err_vs_highs": round(rel_err, 8),
+        "solves_per_sec_batch366": round(sps_366, 2),
+        "serial_ms_per_solve": round(serial_per_solve * 1e3, 3),
     }
 
-    # extras only on the accelerator: the CPU fallback exists to always
-    # report a headline number quickly, not to grind PDHG on one core
-    deadline = time.monotonic() + (22 * 60 if backend != "cpu" else -1)
-
-    # ---- utilization evidence (VERDICT r2 weak #1): the 366-sweep is
-    # far below chip saturation — estimate the PDHG work rate and scale
-    # the batch until throughput flattens ----------------------------
+    # ---- peak-batch throughput: the headline (VERDICT r3 item 1b:
+    # r2 extras showed throughput still rising at batch 4096) ---------
+    peak_sps = sps_366
+    deadline = time.monotonic() + 20 * 60
+    rng = np.random.default_rng(1)
     try:
-        if time.monotonic() < deadline:
-            r366 = vsolve(
-                {"p": {"lmp": jnp.asarray(lmps[:N_SCENARIOS]),
-                       "wind_cap_cf": jnp.asarray(cfs[:N_SCENARIOS])},
-                 "fixed": params["fixed"]}
-            )
-            iters = float(np.mean(np.asarray(r366.iters)))
-            m_rows = int(nlp.m_eq + nlp.m_ineq)
-            # 2 matvecs (fwd + adjoint) x 2 flops/nnz per PDHG
-            # iteration, dense A of (m_rows x n)
-            flops_per_solve = iters * 4.0 * m_rows * nlp.n
-            gflops = flops_per_solve * solves_per_sec / 1e9
-            out["pdhg_iters_mean"] = round(iters, 1)
-            out["est_gflops_366batch"] = round(gflops, 2)
-    except Exception as exc:  # pragma: no cover - telemetry only
-        out["util_error"] = str(exc)[:120]
-
-    try:
-        peak_sps = solves_per_sec
-        for B in (1024, 4096):
+        # CPU fallback: report the 366-batch headline only — grinding a
+        # 4096-wide PDHG batch on one core would blow the child timeout
+        for B in (PEAK_BATCHES if backend != "cpu" else ()):
             if time.monotonic() > deadline:
                 break
-            lmps_b = np.tile(lmps, (B // N_SCENARIOS + 1, 1))[:B]
-            cfs_b = np.tile(cfs, (B // N_SCENARIOS + 1, 1))[:B]
+            lmps_b, cfs_b = _scenarios(B, rng)
             sweep_b = make_sweep(B)
             sweep_b(lmps_b, cfs_b)  # compile
             t0 = time.perf_counter()
             for _ in range(2):
                 sweep_b(lmps_b, cfs_b)
-            per = (time.perf_counter() - t0) / 2
-            sps = B / per
+            sps = B / ((time.perf_counter() - t0) / 2)
             out[f"solves_per_sec_batch{B}"] = round(sps, 2)
             peak_sps = max(peak_sps, sps)
-        out["solves_per_sec_peak"] = round(peak_sps, 2)
-        out["vs_baseline_peak"] = round(peak_sps * serial_per_solve, 2)
     except Exception as exc:
         out["batch_scaling_error"] = str(exc)[:120]
 
-    # ---- NLP workload (VERDICT r2 item 4c): fixed-design wind+battery
-    # +PEM price-taker re-solved across an LMP batch on the IPM -------
+    out.update(
+        metric="pricetaker_24h_solves_per_sec_peak",
+        value=round(peak_sps, 2),
+        unit="solves/s",
+        vs_baseline=round(peak_sps * serial_per_solve, 2),
+    )
+
+    # ---- extras (accelerator only; the CPU fallback exists to report
+    # a headline quickly, not to grind PDHG on one core) ---------------
+    if backend == "cpu":
+        print(json.dumps(out))
+        return
+
+    # utilization evidence: PDHG work rate on the 366 sweep
+    try:
+        if time.monotonic() < deadline:
+            r366 = vsolve(batched_params(lmps, cfs))
+            iters = float(np.mean(np.asarray(r366.iters)))
+            m_rows = int(nlp.m_eq + nlp.m_ineq)
+            flops_per_solve = iters * 4.0 * m_rows * nlp.n
+            out["pdhg_iters_mean"] = round(iters, 1)
+            out["est_gflops_peak"] = round(
+                flops_per_solve * peak_sps / 1e9, 2)
+    except Exception as exc:  # pragma: no cover - telemetry only
+        out["util_error"] = str(exc)[:120]
+
+    # f32 IPM as an LP path on the same production model (VERDICT r3
+    # item 1b), batch 64
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+            ipm = make_ipm_solver(
+                nlp, IPMOptions(max_iter=120, dtype="float32"))
+            vipm = jax.jit(jax.vmap(ipm, in_axes=in_axes))
+            B2 = 64
+            bp = batched_params(lmps[:B2], cfs[:B2])
+            rr = vipm(bp)  # compile + solve
+            t0 = time.perf_counter()
+            rr = vipm(bp)
+            per = time.perf_counter() - t0
+            out["ipm_f32_solves_per_sec_batch64"] = round(B2 / per, 2)
+            out["ipm_f32_converged_frac"] = round(
+                float(np.mean(np.asarray(rr.converged))), 3)
+    except Exception as exc:
+        out["ipm_bench_error"] = str(exc)[:120]
+
+    # NLP workload: wind+battery+PEM price-taker on the IPM, batch 32
     try:
         if time.monotonic() < deadline:
             from dispatches_tpu.case_studies.renewables.wind_battery_pem_lmp \
                 import wind_battery_pem_optimize
             from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
 
-            Tn = 24
             rng2 = np.random.default_rng(1)
-            base_lmp = 35.0 + 25.0 * np.sin(2 * np.pi * np.arange(Tn) / 24)
+            base_lmp = 35.0 + 25.0 * np.sin(2 * np.pi * np.arange(T) / 24)
             nlp_params = {
                 "wind_mw": 200.0, "batt_mw": 25.0, "pem_mw": 25.0,
                 "design_opt": False, "extant_wind": True,
-                "capacity_factors": 0.35
-                + 0.3 * rng2.random(Tn),
+                "capacity_factors": 0.35 + 0.3 * rng2.random(T),
                 "DA_LMPs": base_lmp,
             }
-            r_pem = wind_battery_pem_optimize(Tn, nlp_params)
+            r_pem = wind_battery_pem_optimize(T, nlp_params)
             nlp2 = r_pem.nlp
             B2 = 32
             lmp_batch = (base_lmp[None, :]
-                         + 10.0 * rng2.standard_normal((B2, Tn))) * 1e-3
+                         + 10.0 * rng2.standard_normal((B2, T))) * 1e-3
             ipm = make_ipm_solver(nlp2, IPMOptions(max_iter=200))
             p2 = nlp2.default_params()
             vsolve2 = jax.jit(jax.vmap(
-                ipm, in_axes=({"p": {**{k: None for k in p2["p"]},
-                                     "lmp": 0},
+                ipm, in_axes=({"p": {**{k: None for k in p2["p"]}, "lmp": 0},
                                "fixed": None},)))
             batched2 = {
                 "p": {**{k: jnp.asarray(v) for k, v in p2["p"].items()},
                       "lmp": jnp.asarray(lmp_batch)},
-                "fixed": {k: jnp.asarray(v)
-                          for k, v in p2["fixed"].items()},
+                "fixed": {k: jnp.asarray(v) for k, v in p2["fixed"].items()},
             }
             rr = vsolve2(batched2)  # compile + solve
             t0 = time.perf_counter()
             rr = vsolve2(batched2)
             per = time.perf_counter() - t0
-            conv = float(np.mean(np.asarray(rr.converged)))
             out["nlp_pem24h_solves_per_sec_batch32"] = round(B2 / per, 2)
-            out["nlp_pem24h_converged_frac"] = round(conv, 3)
+            out["nlp_pem24h_converged_frac"] = round(
+                float(np.mean(np.asarray(rr.converged))), 3)
     except Exception as exc:
         out["nlp_bench_error"] = str(exc)[:120]
 
-    # ---- long-horizon LP: one 8736-h annual wind+battery price-taker
-    # (the multiperiod "sequence length" axis, SURVEY.md §5) ----------
+    # long-horizon LP: one 8736-h annual wind+battery price-taker (the
+    # multiperiod "sequence length" axis, SURVEY.md §5)
     try:
         if time.monotonic() < deadline:
             T8 = 8736
-            fs8 = Flowsheet(horizon=T8)
-            fs8.add_var("wind_elec", lb=0, ub=1e6, scale=1e3)
-            fs8.add_var("grid", lb=0, ub=1e6, scale=1e3)
-            fs8.add_var("batt_in", lb=0, ub=1e6, scale=1e3)
-            fs8.add_var("batt_out", lb=0, ub=1e6, scale=1e3)
-            fs8.add_var("soc", lb=0, ub=4e6, scale=1e3)
-            fs8.add_var("soc0", shape=(), lb=0)
-            fs8.fix("soc0", 0.0)
             rng3 = np.random.default_rng(2)
-            fs8.add_param("lmp", 0.02 + 0.015 * rng3.random(T8))
-            fs8.add_param("wind_cap_cf", 400e3 * (0.4 + 0.6 * rng3.random(T8)))
-            fs8.add_eq("power_balance",
-                       lambda v, p: v["wind_elec"] - v["grid"] - v["batt_in"])
-            fs8.add_eq("soc_evolution",
-                       lambda v, p: v["soc"] - tshift(v["soc"], v["soc0"])
-                       - 0.95 * v["batt_in"] + v["batt_out"] / 0.95)
-            fs8.add_ineq("wind_cf",
-                         lambda v, p: v["wind_elec"] - p["wind_cap_cf"])
-            fs8.add_ineq("batt_p_in", lambda v, p: v["batt_in"] - 300e3)
-            fs8.add_ineq("batt_p_out", lambda v, p: v["batt_out"] - 300e3)
-            nlp8 = fs8.compile(
-                objective=lambda v, p: jnp.sum(
-                    p["lmp"] * (v["grid"] + v["batt_out"])),
-                sense="max")
+            params8 = {
+                "wind_mw": WIND_MW, "batt_mw": BATT_MW,
+                "design_opt": False, "extant_wind": True,
+                "capacity_factors": np.clip(
+                    0.35 + 0.3 * rng3.random(T8), 0, 1),
+                "DA_LMPs": np.clip(
+                    35.0 + 25.0 * rng3.standard_normal(T8), 0, 200),
+            }
+            _, nlp8 = wind_battery_pricetaker_nlp(T8, params8)
             solver8 = jax.jit(make_pdlp_solver(
                 nlp8, PDLPOptions(tol=1e-5, dtype="float32")))
             p8 = nlp8.default_params()
             r8 = solver8(p8)  # compile + solve
             t0 = time.perf_counter()
             r8 = solver8(p8)
-            out["horizon8736_lp_seconds"] = round(
-                time.perf_counter() - t0, 3)
+            out["horizon8736_lp_seconds"] = round(time.perf_counter() - t0, 3)
             out["horizon8736_converged"] = bool(np.asarray(r8.converged))
     except Exception as exc:
         out["horizon8736_error"] = str(exc)[:120]
 
     print(json.dumps(out))
+
+
+# ---------------------------------------------------------------------
+# parent: probe + child orchestration
+# ---------------------------------------------------------------------
+
+def _probe_backend(budget_s: float = 900.0) -> bool:
+    """True iff a non-CPU JAX backend initializes in a fresh subprocess.
+    A downed tunnel HANGS device init rather than erroring (observed),
+    so each try gets a hard timeout; retries back off exponentially up
+    to ~``budget_s`` total (VERDICT r3 item 1a)."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "import sys; sys.exit(0 if ds and ds[0].platform != 'cpu' else 3)"
+    )
+    t_end = time.monotonic() + budget_s
+    wait = 10.0
+    while True:
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, timeout=75)
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() + wait > t_end:
+            return False
+        time.sleep(wait)
+        wait = min(wait * 2.0, 240.0)
+
+
+def _run_child(force_cpu: bool, timeout_s: float):
+    env = dict(os.environ, **{CHILD_ENV: "1"})
+    if force_cpu:
+        env["DISPATCHES_BENCH_FORCE_CPU"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, timeout=timeout_s,
+                           env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return line
+    return None
+
+
+def main():
+    if os.environ.get(CHILD_ENV):
+        run_bench()
+        return
+
+    # TPU attempts: probe (backoff) then measure in a bounded child;
+    # one re-probe + retry if the child dies mid-run
+    for attempt in range(2):
+        if not _probe_backend(900.0 if attempt == 0 else 300.0):
+            break
+        line = _run_child(force_cpu=False, timeout_s=40 * 60)
+        if line:
+            print(line)
+            return
+
+    line = _run_child(force_cpu=True, timeout_s=25 * 60)
+    if line:
+        print(line)
+        return
+    raise SystemExit("benchmark failed on both TPU and CPU paths")
 
 
 if __name__ == "__main__":
