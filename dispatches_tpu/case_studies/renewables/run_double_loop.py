@@ -129,7 +129,7 @@ def run_double_loop(options) -> dict:
 
     bidder = bidder_cls(
         bidding_model_object=make_mp(),
-        day_ahead_horizon=24,
+        day_ahead_horizon=48,
         real_time_horizon=4,
         n_scenario=options.n_scenario,
         forecaster=backcaster,
@@ -144,8 +144,8 @@ def run_double_loop(options) -> dict:
     sim = MarketSimulator(
         case,
         output_dir=output_dir,
-        sced_horizon=1,
-        ruc_horizon=24,
+        sced_horizon=4,
+        ruc_horizon=48,
         reserve_factor=options.reserve_factor,
         coordinator=coordinator,
     )

@@ -264,12 +264,22 @@ def solve_unit_commitment(
     hours: np.ndarray,
     reserve_factor: float = 0.0,
     use_milp: bool = True,
+    initial_state: "Optional[Dict[str, np.ndarray]]" = None,
 ) -> np.ndarray:
     """Commitment schedule u (H, n_thermal) for the RUC horizon.
 
     Exact MILP via scipy/HiGHS branch-and-cut when ``use_milp`` (the
     host-side co-processing path); otherwise LP relaxation + rounding
-    with a capacity-feasibility repair (the solver-free fallback)."""
+    with a capacity-feasibility repair (the solver-free fallback).
+
+    ``initial_state`` carries cross-day commitment continuity (the role
+    of Prescient's unit ``initial_status``, reference
+    ``run_double_loop.py:309-332`` rolling-horizon options): ``{"on":
+    (G,) bool, "hours": (G,) int}`` where ``hours`` counts how long each
+    unit has been in its current on/off state.  Units still inside
+    their min-up (min-down) window are forced on (off) for the
+    remainder of it, and hour-0 startup costs are charged against the
+    carried state."""
     from scipy.optimize import Bounds, LinearConstraint, linprog, milp
     from scipy.sparse import lil_matrix
 
@@ -330,6 +340,9 @@ def solve_unit_commitment(
         )
     for g, t in enumerate(th):
         span = max(np.sum(t.seg_mw), t.pmax - t.pmin)
+        on0 = t.initial_on
+        if initial_state is not None:
+            on0 = bool(initial_state["on"][g])
         for h in range(H):
             # p_extra <= (pmax-pmin) * u
             add_row([(ip(g, h), 1.0), (iu(g, h), -span)], -np.inf, 0.0)
@@ -337,7 +350,7 @@ def solve_unit_commitment(
             if h == 0:
                 add_row(
                     [(is_(g, h), 1.0), (iu(g, h), -1.0)],
-                    -1.0 if t.initial_on else 0.0,
+                    -1.0 if on0 else 0.0,
                     np.inf,
                 )
             else:
@@ -349,6 +362,14 @@ def solve_unit_commitment(
         # min up/down (aggregated window form)
         mu_h = int(round(t.min_up))
         md_h = int(round(t.min_down))
+        # hour-0 transitions against the carried state: a startup
+        # (shutdown) at h=0 pins the following min-up (min-down) window
+        for tau in range(1, min(mu_h, H)):
+            if not on0:  # startup at 0 => stay on through the window
+                add_row([(iu(g, 0), -1.0), (iu(g, tau), 1.0)], 0.0, np.inf)
+        for tau in range(1, min(md_h, H)):
+            if on0:  # shutdown at 0 => stay off through the window
+                add_row([(iu(g, 0), 1.0), (iu(g, tau), -1.0)], 0.0, np.inf)
         for h in range(1, H):
             for tau in range(h + 1, min(h + mu_h, H)):
                 # u[h] - u[h-1] <= u[tau]
@@ -370,6 +391,19 @@ def solve_unit_commitment(
     ub = np.concatenate(
         [np.ones(2 * G * H), np.full(G * H, np.inf)]
     )
+    if initial_state is not None:
+        # units still inside their min-up/min-down window at the day
+        # boundary are pinned for the remainder of it
+        for g, t in enumerate(th):
+            k = int(initial_state["hours"][g])
+            if bool(initial_state["on"][g]):
+                need = min(int(round(t.min_up)) - k, H)
+                for h in range(max(need, 0)):
+                    lb[iu(g, h)] = 1.0
+            else:
+                need = min(int(round(t.min_down)) - k, H)
+                for h in range(max(need, 0)):
+                    ub[iu(g, h)] = 0.0
     con = LinearConstraint(A, np.asarray(rows_lb), np.asarray(rows_ub))
 
     if use_milp:
@@ -405,7 +439,8 @@ def solve_unit_commitment(
     u = res.x[: G * H].reshape(G, H).T
     u = (u >= 0.5).astype(float)
     # feasibility repair: commit cheapest-capacity units until pmax
-    # covers net load + reserve
+    # covers net load + reserve — but never a unit pinned OFF by its
+    # carried min-down window (ub[iu(g,h)] == 0 from initial_state)
     for h in range(H):
         need = net_load[h] + reserve[h]
         cap = float(np.sum(u[h] * [t.pmax for t in th]))
@@ -413,7 +448,7 @@ def solve_unit_commitment(
         for g in order:
             if cap >= need:
                 break
-            if u[h, g] == 0:
+            if u[h, g] == 0 and ub[iu(g, h)] > 0.5:
                 u[h, g] = 1.0
                 cap += th[g].pmax
     return u
@@ -661,8 +696,8 @@ class MarketSimulator:
         self,
         case: MarketCase,
         output_dir,
-        sced_horizon: int = 1,
-        ruc_horizon: int = 24,
+        sced_horizon: int = 4,
+        ruc_horizon: int = 48,
         reserve_factor: float = 0.0,
         use_milp: bool = True,
         coordinator=None,
@@ -742,11 +777,25 @@ class MarketSimulator:
         rn_names = [r.name for r in self._da_lp.rn]
         summary_rows, bus_rows, th_rows, rn_rows = [], [], [], []
         total_cost = 0.0
+        uc_case = _case_for_uc(case, self._pname)
+        # cross-day commitment state (Prescient's rolling initial_status;
+        # the 48-h RUC lookahead re-optimizes day d+1 but the implemented
+        # day-d tail still binds min-up/min-down continuity)
+        uc_state = {
+            "on": np.array([t.initial_on for t in uc_case.thermals]),
+            "hours": np.array(
+                [max(int(round(t.min_up)), 1) if t.initial_on
+                 else max(int(round(t.min_down)), 1)
+                 for t in uc_case.thermals]),
+        }
 
         for day in range(num_days):
             d0 = hour0 + day * 24
-            H = min(self.ruc_horizon, case.n_hours - d0)
-            hours = np.arange(d0, d0 + H)
+            # fixed-shape RUC window: near the dataset end the lookahead
+            # hours clamp to the final hour (the compiled DA LP has a
+            # static horizon of ruc_horizon)
+            H = self.ruc_horizon
+            hours = np.clip(np.arange(d0, d0 + H), 0, case.n_hours - 1)
             date = (start + pd.Timedelta(days=day)).strftime("%Y-%m-%d")
 
             da_bids = None
@@ -754,11 +803,26 @@ class MarketSimulator:
                 da_bids = self.coordinator.request_da_bids(date)
 
             u = solve_unit_commitment(
-                _case_for_uc(case, self._pname),
+                uc_case,
                 hours,
                 reserve_factor=self.reserve_factor,
                 use_milp=self.use_milp,
+                initial_state=uc_state,
             )
+            # advance the carried state over the implemented day
+            n_impl = min(24, H)
+            new_on = uc_state["on"].copy()
+            new_hours = uc_state["hours"].copy()
+            for g in range(u.shape[1]):
+                col = u[:n_impl, g] > 0.5
+                run = 1
+                while run < n_impl and col[n_impl - 1 - run] == col[-1]:
+                    run += 1
+                if run == n_impl and bool(col[-1]) == bool(uc_state["on"][g]):
+                    run += int(uc_state["hours"][g])  # run spans the day
+                new_on[g] = bool(col[-1])
+                new_hours[g] = run
+            uc_state = {"on": new_on, "hours": new_hours}
             params = self._da_lp.params_for(
                 hours, u, rt=False, participant_bids=da_bids
             )
