@@ -391,9 +391,12 @@ def _hx_sweep(fs, hx: SaltSteamHX, steam: Dict[str, float],
                 (st_out["delta_v"] if st_out["phase"] in ("vap", "two-phase")
                  else st_out["delta_l"]) * w95.RHOC)
         mu_w_out = float(wtr.visc_d(rho_out, float(st_out["T"])))
+        rho_film = None
+        if getattr(hx, "water_film_phase", "inlet") == "vap":
+            rho_film = float(w95.sat_rhov_aux(min(T_w_in, 0.9999 * w95.TC)))
         h_salt, h_steam = film_coefficients(
             g, salt, F_salt, T_salt_in, Ts_out, F_w, rho_w_in, T_w_in,
-            mu_w_out)
+            mu_w_out, rho_w_film=rho_film)
         num, denom = ohtc_terms(g, float(h_salt), float(h_steam))
         U = num / denom
         return Q - U * area * lmtd, (Q, h_out, U, dTin, dTout, st_out)

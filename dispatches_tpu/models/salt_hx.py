@@ -95,12 +95,20 @@ def salt_nusselt(salt_name: str, re, pr, pr_wall, mu_in, mu_out):
 
 def film_coefficients(g: "HXGeometry", salt: LiquidPackage,
                       F_salt, T_salt_in, T_salt_out,
-                      F_w_mol, rho_w_in, T_w_in, mu_w_out):
+                      F_w_mol, rho_w_in, T_w_in, mu_w_out,
+                      rho_w_film=None):
     """Salt- and water-side film coefficients from the reference's
     Nusselt correlations (salt: per-fluid, see :func:`salt_nusselt`;
     steam: 2001 Zavoico — ``integrated_storage...py:206-281`` charge /
     ``:309-391`` discharge).  Pure function of scalars/arrays; shared by
-    the in-graph residuals and the host-side initialization sweep."""
+    the in-graph residuals and the host-side initialization sweep.
+
+    ``rho_w_film`` optionally evaluates the water-side TRANSPORT
+    properties (viscosity, conductivity) at a different density than the
+    heat capacity: the GDP design models read phase-labeled transport
+    properties (``visc_d_phase["Vap"]`` at a subcooled tube inlet,
+    ``discharge_design...py:375-409``) but the UNLABELED ``cp_mol`` of
+    the actual state — see :class:`SaltSteamHX` ``water_film_phase``."""
     mu_s, mu_sw = salt.visc_d(T_salt_in), salt.visc_d(T_salt_out)
     cp_s, cp_sw = salt.cp_mass(T_salt_in), salt.cp_mass(T_salt_out)
     k_s, k_sw = salt.therm_cond(T_salt_in), salt.therm_cond(T_salt_out)
@@ -110,8 +118,10 @@ def film_coefficients(g: "HXGeometry", salt: LiquidPackage,
     nu_s = salt_nusselt(salt.name, re_s, pr_s, pr_sw, mu_s, mu_sw)
     h_salt = k_s * nu_s / g.tube_outer_dia
 
-    mu_w = wtr.visc_d(rho_w_in, T_w_in)
-    k_w = wtr.therm_cond(rho_w_in, T_w_in)
+    if rho_w_film is None:
+        rho_w_film = rho_w_in
+    mu_w = wtr.visc_d(rho_w_film, T_w_in)
+    k_w = wtr.therm_cond(rho_w_film, T_w_in)
     cp_w = w95.cp_dT(rho_w_in / w95.RHOC, T_w_in) / w95.MW  # J/kg/K
     re_w = (F_w_mol * w95.MW * g.tube_inner_dia
             / (g.tube_cs_area * g.n_tubes * mu_w))
@@ -176,12 +186,26 @@ class SaltSteamHX(UnitModel):
                  salt_side: str = "tube",
                  water_in_phase: str = "vap",
                  water_out_phase: str = "wet",
+                 water_film_phase: str = "inlet",
                  geometry: Optional[HXGeometry] = None):
         super().__init__(fs, name)
         if salt_side not in ("tube", "shell"):
             raise ValueError("salt_side must be 'tube' or 'shell'")
+        if water_film_phase not in ("inlet", "vap"):
+            raise ValueError("water_film_phase must be 'inlet' or 'vap'")
         self.salt = salt
         self.salt_side = salt_side
+        # "inlet": water transport props at the actual inlet state (the
+        # integrated model's phase labels match its states,
+        # ``integrated_storage...py:306-409``).  "vap": transport props
+        # on the VAPOR branch at the inlet temperature — the GDP design
+        # models hard-code ``visc_d_phase["Vap"]``/``therm_cond_phase
+        # ["Vap"]`` on the tube side even where the inlet is subcooled
+        # liquid (``discharge_design...py:375-409``); for a subcooled
+        # state the IDAES phase function falls back to the
+        # saturated-vapor branch at that temperature, reproduced here
+        # with the explicit IAPWS auxiliary correlation.
+        self.water_film_phase = water_film_phase
         self.geom = g = geometry or HXGeometry()
 
         water_hot = salt_side == "tube"
@@ -278,11 +302,16 @@ class SaltSteamHX(UnitModel):
             return wtr.visc_d(d * w95.RHOC, v[wout_eos.T])
 
         def film_coeffs(v):
+            rho_film = None
+            if self.water_film_phase == "vap":
+                rho_film = w95.sat_rhov_aux(
+                    jnp.minimum(v[win_eos.T], 0.9999 * w95.TC))
             return film_coefficients(
                 g, salt,
                 v[sin.flow_mass], v[sin.temperature], v[sout.temperature],
                 v[win.flow_mol], v[win_eos.delta] * w95.RHOC, v[win_eos.T],
                 mu_out_water(v),
+                rho_w_film=rho_film,
             )
 
         self._film_coeffs = film_coeffs

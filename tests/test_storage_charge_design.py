@@ -64,9 +64,10 @@ def test_solar_hp_design():
     m = cd.build_charge_model("solar_salt", "hp", load_from_file=INIT)
     out = cd.design_optimize(m, maxiter=150)
     assert out["converged"] or out["res"].inner_failures == 0
-    # reference anchor 1,838.2 m2; see module docstring for the costing-
-    # basis window
-    assert out["hxc_area"] == pytest.approx(1838.2, rel=0.08)
+    # reference anchor 1,838.2 m2 (ref asserts abs 1e-1); the SSLW
+    # costing basis is pinned against this + the discharge anchor
+    # (HX_COST_BASIS note in the module), landing at 1,836.8 m2
+    assert out["hxc_area"] == pytest.approx(1838.2, rel=1e-2)
     assert out["salt_T_out"] < cd.SALT_T_MAX["solar_salt"] + 1e-6
     sol = out["sol"]
     assert sol["plant_power_out"][0] == pytest.approx(400.0, abs=1e-6)

@@ -1,0 +1,67 @@
+"""Discharge design-study tests, mirroring the reference's
+``storage/tests/test_discharge_usc_powerplant.py``: model construction
+per condensate-source disjunct, the costing surface, and the design
+anchor — the GDP optimum selects the condenser-pump source with a
+1,912.2 m² exchanger (:139-142).
+
+The winning-source design NLP runs un-gated (like the charge study's
+anchor test); the full 5-source enumeration is DISPATCHES_TPU_SLOW-
+gated (scheduled slow lane)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.fossil import storage_discharge_design as dd
+
+
+def test_source_census():
+    # the five condensate-source disjuncts (reference :511-733)
+    assert dd.SOURCES == ("condpump", "fwh4", "booster", "bfp", "fwh9")
+    assert dd.HEAT_DUTY_FIXED == 148.5
+    assert dd.POWER_FIXED == 400.0
+    assert dd.SALT_T_HOT == 831.15
+
+
+def test_cost_expression_data():
+    # Solar-salt-only study (reference imports only solarsalt :64); the
+    # salt inventory is priced for the full plant life (:890-897)
+    assert dd.SALT_PRICE == 0.49
+    assert dd.ES_TURBINE_EFF == 0.8
+    assert dd.AREA_MAX == 5000.0
+
+
+@pytest.mark.skipif(
+    bool(os.environ.get("DISPATCHES_TPU_FAST")),
+    reason="condpump design NLP ~10 min on single-core CPU",
+)
+def test_condpump_design_anchor():
+    """The reference's GDP optimum: condenser-pump condensate source,
+    HX area 1,912.2 m² (``test_discharge_usc_powerplant.py:139-142``).
+    The area sits at the dTin >= 10 K approach-temperature bound, so it
+    is pinned by the OHTC physics (U ~= 1,214 W/m2K) rather than the
+    costing basis."""
+    m = dd.build_discharge_model("condpump")
+    out = dd.design_optimize(m, maxiter=150)
+    assert out["converged"] or out["res"].inner_failures == 0
+    assert out["hxd_area"] == pytest.approx(1912.2, rel=1e-2)
+    # salt cools to the solarsalt stability floor; the storage turbine
+    # contributes tens of MW
+    assert out["salt_T_out"] == pytest.approx(513.15, abs=1.0)
+    assert 20.0 < out["es_power_mw"] < 60.0
+    sol = out["sol"]
+    assert sol["plant_power_out"][0] == pytest.approx(400.0, abs=1e-6)
+    assert sol["hxd.heat_duty"][0] == pytest.approx(148.5e6, abs=10.0)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DISPATCHES_TPU_SLOW"),
+    reason="full 5-source enumeration: five design NLP compiles exceed "
+           "the single-core CPU suite budget",
+)
+def test_design_study_selects_condpump():
+    out = dd.run_design_study(maxiter=120)
+    best = out["best"]
+    assert best is not None
+    assert best["source"] == "condpump"
