@@ -20,6 +20,7 @@ from dispatches_tpu.models.pem_electrolyzer import PEMElectrolyzer
 from dispatches_tpu.models.hydrogen_tank_simplified import SimpleHydrogenTank
 from dispatches_tpu.models.hydrogen_tank import HydrogenTank
 from dispatches_tpu.models.hydrogen_turbine import HydrogenTurbine
+from dispatches_tpu.models.heat_exchanger_tube import ConcreteTubeSide
 from dispatches_tpu.models.translator import Translator
 from dispatches_tpu.models.mixer import Mixer
 
@@ -37,4 +38,5 @@ __all__ = [
     "SimpleHydrogenTank",
     "HydrogenTank",
     "HydrogenTurbine",
+    "ConcreteTubeSide",
 ]
