@@ -163,7 +163,8 @@ class MultiPeriodUscModel:
         self.inventory_max = float(inventory_max)
         self.periodic = periodic
         self.lmp = np.asarray(
-            MOD_RTS_LMP[:self.n_time_points] if lmp is None else lmp,
+            np.resize(MOD_RTS_LMP, self.n_time_points) if lmp is None
+            else lmp,
             dtype=np.float64)
         if self.lmp.shape[0] != self.n_time_points:
             raise ValueError("lmp length must equal n_time_points")
@@ -175,35 +176,51 @@ class MultiPeriodUscModel:
 
     # -- coupling layer ------------------------------------------------
 
-    def _hot_inventory(self, vb):
+    @staticmethod
+    def _hot_inventory(vb, p):
         """Hot-inventory trajectory: ``inv_t = inv0 + 3600·Σ(Fc − Fd)``
         (reference ``constraint_salt_inventory_hot``, :137-144)."""
         Fc = vb["hxc.tube_inlet.flow_mass"][:, 0]
         Fd = vb["hxd.shell_inlet.flow_mass"][:, 0]
-        return self.initial_hot_inventory + 3600.0 * jnp.cumsum(Fc - Fd)
+        return p["initial_hot_inventory"] + 3600.0 * jnp.cumsum(Fc - Fd)
 
     def _build_batched(self) -> None:
-        lmp = jnp.asarray(self.lmp)
         T = self.n_time_points
-        inv0 = self.initial_hot_inventory
-        p_prev = self.previous_power
         hot_inv = self._hot_inventory
+
+        # LMP signal, initial conditions and the dispatch-tracking terms
+        # are RUNTIME parameters: the rolling-horizon double loop
+        # (``multiperiod_double_loop.MultiPeriodUsc``) rebinds them every
+        # market hour without recompiling the batched kernel
+        runtime = {
+            "lmp": jnp.asarray(self.lmp),
+            "previous_power": jnp.asarray(self.previous_power),
+            "initial_hot_inventory": jnp.asarray(
+                self.initial_hot_inventory),
+            "market_dispatch": jnp.zeros(T),
+            "dispatch_penalty": jnp.asarray(0.0),
+        }
 
         def objective(vb, p):
             # reference `pricetaker...py:94-107` (their scaling factors
             # are 1; the 1e-3 here only conditions the outer trust
-            # region — reported objectives are unscaled)
-            rev = jnp.sum(lmp * vb["net_power"][:, 0])
+            # region — reported objectives are unscaled).  The
+            # dispatch-deviation term (off in price-taker mode) is the
+            # tracker's penalized |P - dispatch| in smooth form.
+            net = vb["net_power"][:, 0]
+            rev = jnp.sum(p["lmp"] * net)
             cost = jnp.sum(
                 vb["operating_cost"] + vb["plant_fixed_operating_cost"]
                 + vb["plant_variable_operating_cost"]) / (365.0 * 24.0)
-            return (rev - cost) * OBJ_SCALE
+            dev = jnp.sum(jnp.sqrt(
+                (net - p["market_dispatch"]) ** 2 + 1e-4))
+            return (rev - cost - p["dispatch_penalty"] * dev) * OBJ_SCALE
 
         def ramp_rows(vb, p):
             # ±60 MW/h on plant power, seeded by previous_power
             # (reference :125-135 + linking pairs :334-342)
             power = vb["plant_power_out"][:, 0]
-            prev = tshift(power, jnp.asarray(p_prev))
+            prev = tshift(power, p["previous_power"])
             return jnp.concatenate([
                 (power - prev - RAMP_MW) * 1e-2,
                 (prev - power - RAMP_MW) * 1e-2,
@@ -218,8 +235,8 @@ class MultiPeriodUscModel:
             # (reference :146-164)
             Fc = vb["hxc.tube_inlet.flow_mass"][:, 0]
             Fd = vb["hxd.shell_inlet.flow_mass"][:, 0]
-            inv = hot_inv(vb)
-            prev_inv = tshift(inv, jnp.asarray(inv0))
+            inv = hot_inv(vb, p)
+            prev_inv = tshift(inv, p["initial_hot_inventory"])
             cold_prev = salt_amount - prev_inv
             return jnp.concatenate([
                 (3600.0 * Fd - prev_inv) * 1e-5,
@@ -234,7 +251,8 @@ class MultiPeriodUscModel:
                 # hot inventory returns to its initial level
                 # (reference ``periodic_variable_pair`` /
                 # `pricetaker...py:88-90`)
-                return (hot_inv(vb)[-1] - inv0) * 1e-5
+                return (hot_inv(vb, p)[-1]
+                        - p["initial_hot_inventory"]) * 1e-5
             coupling_eqs.append(("periodic_hot_inventory", periodic_row))
 
         self.brs = BatchedReducedSpaceNLP(
@@ -246,15 +264,45 @@ class MultiPeriodUscModel:
             newton_options=NewtonOptions(max_iter=80),
             u_scales={"ess_hp_split.split_fraction_2": 0.01,
                       "ess_bfp_split.split_fraction_2": 0.01},
+            runtime_params=runtime,
         )
 
     # ------------------------------------------------------------------
 
     def solve(self, U0: Optional[np.ndarray] = None, maxiter: int = 300,
-              verbose: int = 0):
-        res = self.brs.solve(U0=U0, u_bounds=dict(U_BOUNDS),
+              verbose: int = 0, X0: Optional[np.ndarray] = None,
+              lmp: Optional[np.ndarray] = None,
+              previous_power: Optional[float] = None,
+              initial_hot_inventory: Optional[float] = None,
+              market_dispatch: Optional[np.ndarray] = None,
+              dispatch_penalty: Optional[float] = None):
+        """Solve the multiperiod program.  The keyword overrides rebind
+        the runtime parameters (LMP signal, carried state, tracking
+        terms) without recompiling — the double-loop wrappers call this
+        every market hour."""
+        if lmp is not None:
+            self.lmp = np.asarray(lmp, dtype=np.float64)
+        if previous_power is not None:
+            self.previous_power = float(previous_power)
+        if initial_hot_inventory is not None:
+            self.initial_hot_inventory = float(initial_hot_inventory)
+        # the instance attributes are authoritative for the carried
+        # state and the LMP signal — rebind them every solve so callers
+        # that mutate the attributes (the double-loop protocol) never
+        # run the kernel on stale build-time values
+        rp = {
+            "lmp": np.asarray(self.lmp, dtype=np.float64),
+            "previous_power": float(self.previous_power),
+            "initial_hot_inventory": float(self.initial_hot_inventory),
+        }
+        if market_dispatch is not None:
+            rp["market_dispatch"] = np.asarray(market_dispatch,
+                                               dtype=np.float64)
+        if dispatch_penalty is not None:
+            rp["dispatch_penalty"] = float(dispatch_penalty)
+        res = self.brs.solve(U0=U0, X0=X0, u_bounds=dict(U_BOUNDS),
                              maxiter=maxiter, verbose=verbose,
-                             gtol=1e-6, xtol=1e-9)
+                             gtol=1e-6, xtol=1e-9, runtime_params=rp)
         res = res._replace(obj=res.obj / OBJ_SCALE)
         sol = self.brs.stack_solution(res.X, res.U)
         inv = np.asarray(self.initial_hot_inventory + 3600.0 * np.cumsum(

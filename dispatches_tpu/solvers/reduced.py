@@ -280,7 +280,14 @@ class BatchedReducedSpaceNLP:
                  coupling_ineqs: Sequence[Tuple[str, object]] = (),
                  coupling_eqs: Sequence[Tuple[str, object]] = (),
                  newton_options: Optional[NewtonOptions] = None,
-                 u_scales: Optional[Dict[str, float]] = None):
+                 u_scales: Optional[Dict[str, float]] = None,
+                 runtime_params: Optional[Dict[str, object]] = None):
+        # ``runtime_params``: named arrays visible to the objective and
+        # coupling callables through the ``p`` argument, re-bindable at
+        # each ``solve(runtime_params=...)`` WITHOUT recompiling the
+        # batched evaluation (they are traced jit arguments, not baked
+        # constants) — the rolling-horizon market loop rebinds the LMP /
+        # dispatch signals this way every hour.
         base = ReducedSpaceNLP(nlp, decisions, newton_options, u_scales)
         self.base = base
         self.nlp = nlp
@@ -338,24 +345,29 @@ class BatchedReducedSpaceNLP:
 
         from dispatches_tpu.core.graph import Vals
 
-        def f_fn(X, U):
-            vb = Vals(stack_vals(X, U))
-            return sgn * objective(vb, Vals(p_vals))
+        self._rp0 = {k: jnp.asarray(v)
+                     for k, v in (runtime_params or {}).items()}
 
-        def g2_fn(X, U):
+        def f_fn(X, U, rp):
+            vb = Vals(stack_vals(X, U))
+            return sgn * objective(vb, Vals({**p_vals, **rp}))
+
+        def g2_fn(X, U, rp):
             if not self.coupling_ineqs:
                 return jnp.zeros((0,))
             vb = Vals(stack_vals(X, U))
             return jnp.concatenate([
-                jnp.ravel(fn(vb, Vals(p_vals))) for _, fn in self.coupling_ineqs
+                jnp.ravel(fn(vb, Vals({**p_vals, **rp})))
+                for _, fn in self.coupling_ineqs
             ])
 
-        def e3_fn(X, U):
+        def e3_fn(X, U, rp):
             if not self.coupling_eqs:
                 return jnp.zeros((0,))
             vb = Vals(stack_vals(X, U))
             return jnp.concatenate([
-                jnp.ravel(fn(vb, Vals(p_vals))) for _, fn in self.coupling_eqs
+                jnp.ravel(fn(vb, Vals({**p_vals, **rp})))
+                for _, fn in self.coupling_eqs
             ])
 
         def per_hour_ineq(x, u):
@@ -364,31 +376,31 @@ class BatchedReducedSpaceNLP:
         def per_hour_eq(x, u):
             return nlp.eq(x, patch(params0, u))
 
-        def evaluate(U, Xw):
+        def evaluate(U, Xw, rp):
             params_b = batched_params(U)
             res = newton_b(params_b, Xw)
             X = res.x
 
-            f = f_fn(X, U)
+            f = f_fn(X, U, rp)
             g1 = jax.vmap(per_hour_ineq)(X, U)            # (T, m1)
-            g2 = g2_fn(X, U)                              # (m2,)
-            e3 = e3_fn(X, U)                              # (m3,)
+            g2 = g2_fn(X, U, rp)                          # (m2,)
+            e3 = e3_fn(X, U, rp)                          # (m3,)
             m1, m2, m3 = g1.shape[1], g2.shape[0], e3.shape[0]
 
             # ---- gradients ------------------------------------------
-            fX = jax.grad(f_fn, argnums=0)(X, U)          # (T, n)
-            fU = jax.grad(f_fn, argnums=1)(X, U)          # (T, m_u)
+            fX = jax.grad(f_fn, argnums=0)(X, U, rp)      # (T, n)
+            fU = jax.grad(f_fn, argnums=1)(X, U, rp)      # (T, m_u)
             G1x = jax.vmap(jax.jacfwd(per_hour_ineq, argnums=0))(X, U)
             G1u = jax.vmap(jax.jacfwd(per_hour_ineq, argnums=1))(X, U)
             if m2:
-                G2x = jax.jacrev(g2_fn, argnums=0)(X, U)  # (m2, T, n)
-                G2u = jax.jacrev(g2_fn, argnums=1)(X, U)  # (m2, T, m_u)
+                G2x = jax.jacrev(g2_fn, argnums=0)(X, U, rp)  # (m2, T, n)
+                G2u = jax.jacrev(g2_fn, argnums=1)(X, U, rp)  # (m2, T, m_u)
             else:
                 G2x = jnp.zeros((0, T_, nlp.n))
                 G2u = jnp.zeros((0, T_, self.base.m_u))
             if m3:
-                E3x = jax.jacrev(e3_fn, argnums=0)(X, U)
-                E3u = jax.jacrev(e3_fn, argnums=1)(X, U)
+                E3x = jax.jacrev(e3_fn, argnums=0)(X, U, rp)
+                E3u = jax.jacrev(e3_fn, argnums=1)(X, U, rp)
             else:
                 E3x = jnp.zeros((0, T_, nlp.n))
                 E3u = jnp.zeros((0, T_, self.base.m_u))
@@ -446,8 +458,15 @@ class BatchedReducedSpaceNLP:
               u_bounds: Optional[Dict[str, Tuple[float, float]]] = None,
               maxiter: int = 300, xtol: float = 1e-10, gtol: float = 1e-8,
               solver_options: Optional[Dict] = None,
+              runtime_params: Optional[Dict[str, object]] = None,
               verbose: int = 0) -> BatchedReducedResult:
         T_, m_u, nlp = self.T, self.base.m_u, self.nlp
+        rp = {**self._rp0,
+              **{k: jnp.asarray(v)
+                 for k, v in (runtime_params or {}).items()}}
+        unknown = set(rp) - set(self._rp0)
+        if unknown:
+            raise KeyError(f"unknown runtime params {sorted(unknown)}")
         if U0 is None:
             U0 = np.tile(self.base.u0, (T_, 1))
         U0 = np.asarray(U0, dtype=np.float64).reshape(T_, m_u)
@@ -471,13 +490,14 @@ class BatchedReducedSpaceNLP:
             if state["key"] != key:
                 U = u.reshape(T_, m_u)
                 out = self._evaluate_b(jnp.asarray(U),
-                                       jnp.asarray(state["x"]))
+                                       jnp.asarray(state["x"]), rp)
                 out = [np.asarray(o) for o in out]
                 conv = out[9]
                 if not conv.all():
                     # cold-restart the failed periods once
                     Xr = np.where(conv[:, None], out[0], X_cold)
-                    out2 = self._evaluate_b(jnp.asarray(U), jnp.asarray(Xr))
+                    out2 = self._evaluate_b(jnp.asarray(U), jnp.asarray(Xr),
+                                            rp)
                     out2 = [np.asarray(o) for o in out2]
                     if out2[9].sum() > conv.sum():
                         out, conv = out2, out2[9]

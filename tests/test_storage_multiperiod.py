@@ -79,15 +79,14 @@ def test_multiperiod_model_coupling():
 def test_hot_inventory_trajectory(usc_model):
     # the inventory balance (reference constraint_salt_inventory_hot,
     # :137-144) over a synthetic 4-hour trajectory
-    mp = smp.MultiPeriodUscModel.__new__(smp.MultiPeriodUscModel)
-    mp.initial_hot_inventory = 1e6
     Fc = np.array([100.0, 0.0, 50.0, 0.0])
     Fd = np.array([0.0, 20.0, 0.0, 80.0])
     vb = Vals({
         "hxc.tube_inlet.flow_mass": Fc[:, None],
         "hxd.shell_inlet.flow_mass": Fd[:, None],
     })
-    inv = np.asarray(mp._hot_inventory(vb))
+    inv = np.asarray(smp.MultiPeriodUscModel._hot_inventory(
+        vb, Vals({"initial_hot_inventory": 1e6})))
     expect = 1e6 + 3600.0 * np.cumsum(Fc - Fd)
     np.testing.assert_allclose(inv, expect, rtol=1e-12)
 
