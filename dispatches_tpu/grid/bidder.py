@@ -84,9 +84,9 @@ class _BidderBase:
             first_stage_bounds=(md.p_min, md.p_max),
             first_stage_scale=max(md.p_max, 1.0) / 2.0,
         )
-        blk.solve = jax.jit(
-            make_ipm_solver(blk.stacked, IPMOptions(max_iter=self._max_iter))
-        )
+        blk.solver_fn = make_ipm_solver(
+            blk.stacked, IPMOptions(max_iter=self._max_iter))
+        blk.solve = jax.jit(blk.solver_fn)
         return blk
 
     def _scenario_solve(self, blk, prices: np.ndarray):
@@ -109,6 +109,55 @@ class _BidderBase:
                 date, hour, bus, horizon, self.n_scenario
             )
         )
+
+    def compute_day_ahead_bids_batch(self, dates, mesh=None):
+        """Day-parallel projection/bidding solves (SURVEY §2.7 row 3 —
+        the rolling-horizon axis the reference leaves strictly serial
+        inside Prescient): the per-day two-stage bid programs are
+        independent given the forecaster state, so all D days solve as
+        ONE vmapped IPM batch, optionally sharded over a device
+        ``mesh`` (day axis = data axis).  The caller re-syncs realized
+        state sequentially through the usual ``update_*_model`` hooks
+        (windowed re-sync).
+
+        Returns ``{date: bids}`` with bids formatted exactly like
+        ``compute_day_ahead_bids``."""
+        blk = self.day_ahead_model
+        H = self.day_ahead_horizon
+        prices_days = np.stack([
+            np.asarray(self._forecast(d, 0, H)) for d in dates
+        ])  # (D, n_scenario, H)
+        params = blk.stacked.default_params()
+        # the compiled D-wide batch solver is cached on the model block:
+        # jit caches by function identity, so rebuilding vmap(...) per
+        # rolling window would recompile the whole IPM batch every call
+        cache = getattr(blk, "_batch_solvers", None)
+        if cache is None:
+            cache = blk._batch_solvers = {}
+        vsolve = cache.get(len(dates))
+        if vsolve is None:
+            in_axes = ({"p": {k: (0 if k == "energy_price" else None)
+                              for k in params["p"]},
+                        "fixed": None},)
+            vsolve = jax.jit(jax.vmap(blk.solver_fn, in_axes=in_axes))
+            cache[len(dates)] = vsolve
+        arr = jnp.asarray(prices_days)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            arr = jax.device_put(arr, NamedSharding(mesh, P(mesh.axis_names[0])))
+        batched = {"p": {**params["p"], "energy_price": arr},
+                   "fixed": params["fixed"]}
+        res = vsolve(batched)
+        xs = np.asarray(res.x)
+        out = {}
+        for i, d in enumerate(dates):
+            day_params = {"p": {**params["p"],
+                                "energy_price": jnp.asarray(prices_days[i])},
+                          "fixed": params["fixed"]}
+            powers = blk.stacked.scenario_profiles(xs[i], day_params)
+            out[d] = self._format_bids(blk, prices_days[i], powers, xs[i], H)
+        return out
 
     def update_day_ahead_model(self, **profiles):
         self.bidding_model_object.update_model(self.day_ahead_model, **profiles)
@@ -145,23 +194,27 @@ class SelfScheduler(_BidderBase):
     """Self-scheduling participant: bids are per-hour scheduled energies
     (reference test :152-177: ``bids[t][gen]['p_max']``)."""
 
-    def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
-        prices = self._forecast(date, hour, self.day_ahead_horizon)  # $/MWh
-        _, res = self._scenario_solve(self.day_ahead_model, prices)
+    def _format_bids(self, blk, prices, powers, x, horizon) -> Dict:
         # the shared first-stage variable IS the self-schedule: hard
         # non-anticipativity, not a mean of scenario optima
-        schedule = self.day_ahead_model.stacked.first_stage(res.x)
+        schedule = blk.stacked.first_stage(x)
         md = self.bidding_model_object.model_data
-        bids = {
+        return {
             t: {
                 self.generator: {
                     "p_min": md.p_min,
                     "p_max": float(schedule[t]),
                 }
             }
-            for t in range(self.day_ahead_horizon)
+            for t in range(horizon)
         }
-        return bids
+
+    def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
+        prices = self._forecast(date, hour, self.day_ahead_horizon)  # $/MWh
+        powers, res = self._scenario_solve(self.day_ahead_model, prices)
+        return self._format_bids(self.day_ahead_model, prices, powers,
+                                 np.asarray(res.x),
+                                 self.day_ahead_horizon)
 
     def compute_real_time_bids(self, date, hour, realized_day_ahead_prices=None,
                                realized_day_ahead_dispatches=None) -> Dict:
@@ -230,6 +283,9 @@ class Bidder(_BidderBase):
                 }
             }
         return bids
+
+    def _format_bids(self, blk, prices, powers, x, horizon) -> Dict:
+        return self._curves(np.asarray(prices), np.asarray(powers), horizon)
 
     def compute_day_ahead_bids(self, date, hour: int = 0) -> Dict:
         prices = self._forecast(date, hour, self.day_ahead_horizon)
