@@ -45,26 +45,70 @@ def mlp_apply(params, x):
     return h @ last["W"] + last["b"]
 
 
-def _train_mlp(x, y, sizes, epochs=500, seed=0, learning_rate=1e-3):
+def _train_mlp(x, y, sizes, epochs=500, seed=0, learning_rate=1e-3,
+               batch_size=None, mesh=None):
+    """Adam training of an MLP (the reference's 500-epoch Keras fit,
+    ``Train_NN_Surrogates.py:356-401``).
+
+    ``batch_size`` enables shuffled minibatch epochs (the reference's
+    Keras default batch_size=32 behavior) instead of full-batch steps;
+    ``mesh`` additionally shards each (mini)batch over a device mesh's
+    first axis — data-parallel training on the same chips that run the
+    solves (SURVEY.md §2.7 row 4), with XLA inserting the gradient
+    all-reduce from the shardings.
+    """
     params = _init_mlp(sizes, jax.random.PRNGKey(seed))
     tx = optax.adam(learning_rate)
     opt_state = tx.init(params)
-    x = jnp.asarray(x)
-    y = jnp.asarray(y)
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    n = x.shape[0]
+
+    batch_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def _device(arr, sh):
+        arr = jnp.asarray(arr)
+        return jax.device_put(arr, sh) if sh is not None else arr
 
     @jax.jit
-    def step(params, opt_state):
+    def step(params, opt_state, xb, yb):
         def loss_fn(p):
-            pred = mlp_apply(p, x)
-            return jnp.mean((pred - y) ** 2)
+            pred = mlp_apply(p, xb)
+            return jnp.mean((pred - yb) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    if batch_size is None or batch_size >= n:
+        xb = _device(x, batch_sharding)
+        yb = _device(y, batch_sharding)
+        loss = jnp.inf
+        for _ in range(epochs):
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+        return params, float(loss)
+
+    # shuffled minibatch epochs; batches padded to a fixed shape so the
+    # jitted step compiles once (and divides the mesh axis evenly)
+    bs = int(batch_size)
+    if mesh is not None:
+        m_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        bs = max(m_dev, (bs // m_dev) * m_dev)
+    rng = np.random.default_rng(seed)
     loss = jnp.inf
     for _ in range(epochs):
-        params, opt_state, loss = step(params, opt_state)
+        perm = rng.permutation(n)
+        for s in range(0, n, bs):
+            idx = perm[s:s + bs]
+            if len(idx) < bs:  # pad the tail to the compiled shape
+                idx = np.concatenate([idx, perm[: bs - len(idx)]])
+            xb = _device(x[idx], batch_sharding)
+            yb = _device(y[idx], batch_sharding)
+            params, opt_state, loss = step(params, opt_state, xb, yb)
     return params, float(loss)
 
 
@@ -150,7 +194,8 @@ class TrainNNSurrogates:
 
     # -- training (reference :356-484) --------------------------------
 
-    def _train(self, NN_size, split_seed, epochs):
+    def _train(self, NN_size, split_seed, epochs, batch_size=None,
+               mesh=None):
         x, y = self._transform_dict_to_array()
         x_train, x_test, y_train, y_test = _train_test_split(
             x, y, test_size=0.2, seed=split_seed
@@ -161,7 +206,8 @@ class TrainNNSurrogates:
         ystd = np.where(ystd == 0, 1.0, ystd)
         xs, ys = (x_train - xm) / xstd, (y_train - ym) / ystd
 
-        params, train_loss = _train_mlp(xs, ys, NN_size, epochs=epochs)
+        params, train_loss = _train_mlp(xs, ys, NN_size, epochs=epochs,
+                                        batch_size=batch_size, mesh=mesh)
 
         # R2 on the held-out split (reference :421-431, :497-505)
         R2 = None
@@ -183,14 +229,18 @@ class TrainNNSurrogates:
         }
         return params
 
-    def train_NN_frequency(self, NN_size, epochs=500):
+    def train_NN_frequency(self, NN_size, epochs=500, batch_size=None,
+                           mesh=None):
         self.model_type = "frequency"
         self._read_clustering_model(self.data_file)
-        return self._train(NN_size, split_seed=0, epochs=epochs)
+        return self._train(NN_size, split_seed=0, epochs=epochs,
+                           batch_size=batch_size, mesh=mesh)
 
-    def train_NN_revenue(self, NN_size, epochs=500):
+    def train_NN_revenue(self, NN_size, epochs=500, batch_size=None,
+                         mesh=None):
         self.model_type = "revenue"
-        return self._train(NN_size, split_seed=42, epochs=epochs)
+        return self._train(NN_size, split_seed=42, epochs=epochs,
+                           batch_size=batch_size, mesh=mesh)
 
     # -- persistence (reference :516-564) -----------------------------
 
