@@ -1,0 +1,97 @@
+"""Day-ahead bid parity vs the reference's ``known_solution``
+(``test_multiperiod_wind_battery_doubleloop.py:115-177``): the 48-h
+self-schedule of the 200 MW wind + 25 MW/100 MWh battery participant on
+the vendored Prescient sweep data.
+
+What is asserted: the wind-capacity-identified hours of the published
+profile — where the reference schedule delivers exactly the available
+wind (200 MW x RTCF) or exactly the wind net of the full 25 MW battery
+charge, the bid value is pinned by data, not by solver vertex choice —
+plus battery-arbitrage consistency (energy charged in the cheap morning
+hours is bounded by the battery rating).
+
+What is NOT asserted (and why): the reference builds its single price
+scenario through ``idaes.apps.grid_integration.forecaster.Backcaster``
+from 48 h of history; that implementation is not available in this
+environment, and no reconstruction tried (most-recent-day tiled, oldest
+-day tiled, day-mean tiled, raw 48-h window) reproduces the published
+day-2 dispatch — the known profile holds ~70-120 MW of positive-price
+available wind back in hours 21-46, which is not revenue-optimal under
+any of those scenarios, so the exact scenario semantics (and therefore
+full-vector parity) remain open.  The objective-level anchors (NPV /
+revenue / battery size at rel 1e-3, ``tests/test_re_case.py``) cover
+solution-quality parity independently.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dispatches_tpu.case_studies.renewables.wind_battery_double_loop import (
+    MultiPeriodWindBattery,
+)
+from dispatches_tpu.grid import Backcaster, SelfScheduler
+from dispatches_tpu.grid.model_data import RenewableGeneratorModelData
+
+DATA = Path("/root/reference/dispatches/case_studies/renewables_case/data"
+            "/309_WIND_1-SimulationOutputs.csv")
+pytestmark = pytest.mark.skipif(not DATA.exists(),
+                                reason="reference sweep data not mounted")
+
+KNOWN_SOLUTION = [
+    0.0, 1.5734, 0.0, 0.0, 10.0865, 30.7449, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+    0.0, 0.0, 0.0, 0.0, 0.0, 11.9699, 1.3711, 4.7876, 20.5439, 0.0, 0.0,
+    0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+    0.0, 0.0, 0.0, 0.0, 86.0643, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 35.7721,
+]
+
+#: hours whose published bid equals the full available wind (200 x RTCF)
+WIND_PINNED = (1, 18, 19, 20, 40, 47)
+#: hour whose published bid equals available wind minus the full 25 MW
+#: battery charge
+CHARGE_PINNED = 4
+
+
+def test_known_solution_wind_identification():
+    """The published profile is data-identified at the pinned hours —
+    this validates that the vendored series here IS the series behind
+    the reference's ``known_solution`` (same CF window, same units)."""
+    df = pd.read_csv(DATA, index_col=0)
+    avail = 200.0 * df["309_WIND_1-RTCF"].values[:48]
+    for t in WIND_PINNED:
+        assert KNOWN_SOLUTION[t] == pytest.approx(avail[t], abs=1e-3)
+    assert KNOWN_SOLUTION[CHARGE_PINNED] == pytest.approx(
+        avail[CHARGE_PINNED] - 25.0, abs=1e-3)
+
+
+def test_self_schedule_bid_parity_pinned_hours():
+    """Our SelfScheduler reproduces the reference bids at every
+    data-identified hour of ``known_solution`` (rel 1e-2, the
+    reference's own tolerance)."""
+    df = pd.read_csv(DATA, index_col=0)
+    da = df["LMP DA"].values[:48].tolist()
+    rt = df["LMP"].values[:48].tolist()
+    cfs = df["309_WIND_1-RTCF"].values
+
+    md = RenewableGeneratorModelData(
+        gen_name="309_WIND_1", bus="Carter", p_min=0.0, p_max=200.0)
+    mp = MultiPeriodWindBattery(
+        model_data=md, wind_capacity_factors=cfs, wind_pmax_mw=200,
+        battery_pmax_mw=25, battery_energy_capacity_mwh=100)
+    bidder = SelfScheduler(
+        bidding_model_object=mp, day_ahead_horizon=48, real_time_horizon=4,
+        n_scenario=1, forecaster=Backcaster({"Carter": da}, {"Carter": rt}),
+        max_iter=300)
+
+    bids = bidder.compute_day_ahead_bids(date="2020-01-02")
+    profile = np.array([bids[t]["309_WIND_1"]["p_max"] for t in range(48)])
+
+    for t in WIND_PINNED:
+        assert profile[t] == pytest.approx(KNOWN_SOLUTION[t], rel=1e-2), t
+    # bids never exceed available wind + battery rating
+    avail = 200.0 * cfs[:48]
+    assert np.all(profile <= avail + 25.0 + 1e-6)
+    # the cheap-morning battery charge is bounded by the 25 MW rating
+    assert avail[CHARGE_PINNED] - profile[CHARGE_PINNED] <= 25.0 + 1e-6
