@@ -40,7 +40,13 @@ import time
 import numpy as np
 
 T = 24
-N_SCENARIOS = 366  # the annual-sweep batch (SURVEY.md §2.7)
+#: the annual-sweep batch (SURVEY.md §2.7).  This sweep IS the
+#: day-parallel rolling-horizon workload: 366 independent 24-h
+#: price-taker windows (one per simulated day) solved as a single
+#: device batch, the axis the reference leaves strictly serial inside
+#: Prescient; grid.bidder.compute_day_ahead_bids_batch runs the same
+#: shape inside the co-sim with sequential state re-sync.
+N_SCENARIOS = 366
 PEAK_BATCHES = (1024, 4096)
 CHILD_ENV = "DISPATCHES_BENCH_CHILD"
 
