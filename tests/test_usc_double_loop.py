@@ -117,8 +117,42 @@ def test_record_and_write_results(tmp_path):
 @pytest.mark.skipif(
     not (os.environ.get("DISPATCHES_TPU_SLOW")
          and INIT.with_suffix(".json").exists()),
-    reason="USC co-sim: batched physics compiles exceed the single-core "
-           "CPU suite budget (set DISPATCHES_TPU_SLOW=1 to run)",
+    reason="USC bid/track solves: ~35 min cold compile on single-core "
+           "CPU (set DISPATCHES_TPU_SLOW=1 to run)",
+)
+def test_usc_bid_and_track_solves():
+    """Slow lane: drive the bidder and tracker protocol on the REAL
+    reduced-space kernel (one DA bid + two rolling tracking hours) —
+    the per-hour building blocks of the full co-sim below."""
+    from dispatches_tpu.grid.forecaster import Backcaster
+
+    md = usc_model_data()
+    hist = list(22.0 + 3.0 * np.random.default_rng(0).random(24))
+    bidder = UscSelfScheduler(
+        bidding_model_object=MultiPeriodUsc(md, maxiter=25,
+                                            load_from_file=INIT),
+        day_ahead_horizon=2, real_time_horizon=2, n_scenario=1,
+        forecaster=Backcaster({md.bus: hist}, {md.bus: list(hist)}))
+    bids = bidder.compute_day_ahead_bids(date="2020-07-10")
+    sched = [bids[t][md.gen_name]["p_max"] for t in range(2)]
+    assert all(md.p_min - 1e-6 <= p <= md.p_max + 30.0 + 1e-6
+               for p in sched)
+
+    tracker = UscTracker(MultiPeriodUsc(md, maxiter=25,
+                                        load_from_file=INIT),
+                         tracking_horizon=2)
+    tracker.track_market_dispatch([400.0, 410.0], date="2020-07-10", hour=0)
+    p0 = tracker.get_last_delivered_power()
+    assert np.isfinite(p0) and md.p_min - 1e-6 <= p0 <= md.p_max + 30.0
+    # the carried state advanced with the implemented hour
+    assert tracker.model.usc_mp.previous_power == pytest.approx(round(p0))
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("DISPATCHES_TPU_EXTENDED")
+         and INIT.with_suffix(".json").exists()),
+    reason="full 1-day USC co-sim: ~50 reduced-space solves exceed even "
+           "the slow-lane budget (set DISPATCHES_TPU_EXTENDED=1 to run)",
 )
 def test_usc_participant_cosim(tmp_path):
     """The FE participant bids, clears and settles through the 5-bus
