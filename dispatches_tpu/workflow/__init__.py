@@ -11,7 +11,11 @@ by flax/optax trained on the same chips), ``ManagedWorkflow`` /
 
 from dispatches_tpu.workflow.simulation_data import SimulationData
 from dispatches_tpu.workflow.clustering import TimeSeriesClustering
-from dispatches_tpu.workflow.surrogates import TrainNNSurrogates
+from dispatches_tpu.workflow.surrogates import (
+    TrainNNSurrogates,
+    load_pretrained_surrogate,
+    pretrained_surrogates,
+)
 from dispatches_tpu.workflow.managed import (
     Dataset,
     DatasetFactory,
@@ -22,6 +26,8 @@ __all__ = [
     "SimulationData",
     "TimeSeriesClustering",
     "TrainNNSurrogates",
+    "load_pretrained_surrogate",
+    "pretrained_surrogates",
     "ManagedWorkflow",
     "Dataset",
     "DatasetFactory",

@@ -14,6 +14,7 @@ optax under ``jit`` — same architecture, same scaling-metadata json
 from __future__ import annotations
 
 import json
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -275,4 +276,50 @@ class TrainNNSurrogates:
             scaling["xstd_inputs"]
         )
         out = np.asarray(mlp_apply(params, jnp.asarray(x)))
-        return out * np.asarray(scaling["y_std"]) + np.asarray(scaling["y_mean"])
+        # frequency-surrogate jsons name the label moments ws_mean/ws_std
+        # (reference Train_NN_Surrogates.py:607-608); revenue ones y_mean/y_std
+        ystd = scaling.get("y_std", scaling.get("ws_std"))
+        ym = scaling.get("y_mean", scaling.get("ws_mean"))
+        if ystd is None or ym is None:
+            raise KeyError(
+                "scaling json must carry label moments as y_mean/y_std "
+                "or ws_mean/ws_std; got keys " + str(sorted(scaling))
+            )
+        return out * np.asarray(ystd) + np.asarray(ym)
+
+
+# ---------------------------------------------------------------------
+# shipped pre-trained artifacts (ported from the reference's trained
+# Keras SavedModels under train_market_surrogates/dynamic/*_case_study —
+# weight DATA extracted layer-by-layer, reference scaling jsons verbatim)
+# ---------------------------------------------------------------------
+
+_ARTIFACTS_DIR = Path(__file__).resolve().parent / "artifacts"
+
+
+def pretrained_surrogates() -> Dict[str, dict]:
+    """Manifest of the shipped pre-trained market surrogates: the six
+    trained MLPs the reference ships (revenue + dispatch-frequency for
+    the RE/NE/FE case studies).  Note ``FE_revenue`` is flagged
+    ``upstream_nan_weights``: the reference's own SavedModel carries an
+    all-NaN output layer (verified at port time), so it loads but
+    cannot predict — faithfully preserved, not repaired."""
+    with open(_ARTIFACTS_DIR / "manifest.json") as f:
+        return json.load(f)
+
+
+def load_pretrained_surrogate(name: str):
+    """Load a shipped artifact by manifest name (e.g. ``"RE_revenue"``,
+    ``"NE_30clusters_dispatch_frequency"``) → ``(params, scaling)``
+    ready for :meth:`TrainNNSurrogates.predict`."""
+    manifest = pretrained_surrogates()
+    if name not in manifest:
+        raise KeyError(
+            f"unknown pretrained surrogate {name!r}; "
+            f"available: {sorted(manifest)}"
+        )
+    entry = manifest[name]
+    case_dir = _ARTIFACTS_DIR / entry["case"]
+    return TrainNNSurrogates.load_model(
+        case_dir / f"{name}.npz", case_dir / entry["params_json"]
+    )

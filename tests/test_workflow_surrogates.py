@@ -221,3 +221,51 @@ def test_train_minibatch_and_mesh():
     pred = np.asarray(mlp_apply(params, x))
     # explains >95% of the target variance
     assert np.mean((pred - y) ** 2) < 0.05 * np.var(y)
+
+
+# ---------------------------------------------------------------------
+# shipped pre-trained artifacts (ported reference SavedModels)
+# ---------------------------------------------------------------------
+
+GOLD = Path(__file__).parent / "data" / "surrogate_goldens"
+
+
+def test_pretrained_manifest_complete():
+    """All six reference-shipped surrogates are present (revenue +
+    dispatch-frequency for RE/NE/FE, ref ``train_market_surrogates/
+    dynamic/*_case_study``)."""
+    from dispatches_tpu.workflow import pretrained_surrogates
+
+    manifest = pretrained_surrogates()
+    assert sorted(manifest) == sorted([
+        "RE_revenue", "RE_20clusters_dispatch_frequency",
+        "NE_revenue", "NE_30clusters_dispatch_frequency",
+        "FE_revenue", "FE_20clusters_dispatch_frequency",
+    ])
+    # the reference's own FE_revenue SavedModel ships an all-NaN output
+    # layer (verified at port time) — flagged, not repaired
+    assert manifest["FE_revenue"]["upstream_nan_weights"]
+
+
+@pytest.mark.parametrize("name", [
+    "RE_revenue", "RE_20clusters_dispatch_frequency",
+    "NE_revenue", "NE_30clusters_dispatch_frequency",
+    "FE_20clusters_dispatch_frequency",
+])
+def test_pretrained_predict_matches_keras(name):
+    """Ported weights reproduce the reference SavedModel's serving
+    output on golden (input, output) pairs generated through TF at port
+    time (unscaled-x -> unscaled-y convention of ``predict``)."""
+    from dispatches_tpu.workflow import load_pretrained_surrogate
+
+    params, scaling = load_pretrained_surrogate(name)
+    gold = np.load(GOLD / f"{name}_golden.npz")
+    pred = TrainNNSurrogates.predict(params, scaling, gold["x"])
+    np.testing.assert_allclose(pred, gold["y"], rtol=2e-4, atol=1e-3)
+
+
+def test_pretrained_loader_unknown_name():
+    from dispatches_tpu.workflow import load_pretrained_surrogate
+
+    with pytest.raises(KeyError):
+        load_pretrained_surrogate("nope")
