@@ -20,8 +20,11 @@ from __future__ import annotations
 
 import argparse
 from pathlib import Path
+from typing import Optional
 
 import numpy as np
+
+from dispatches_tpu.core.config import ConfigError, config, config_field
 
 from dispatches_tpu.case_studies.renewables.wind_battery_double_loop import (
     MultiPeriodWindBattery,
@@ -38,37 +41,64 @@ from dispatches_tpu.grid.coordinator import DoubleLoopCoordinator
 from dispatches_tpu.grid.market import MarketSimulator, load_rts_gmlc_case
 
 
+@config
+class DoubleLoopOptions:
+    """Typed counterpart of the reference's script options
+    (``run_double_loop.py:40-104``) + the Prescient simulation options
+    it forwards (:309-332) — one validated tier instead of argparse
+    namespace + options dict (SURVEY.md §5)."""
+
+    data_path: str = config_field(
+        "", doc="RTS-GMLC-format dataset directory", required=True)
+    sim_id: int = config_field(0, bounds=(0, None), doc="simulation index")
+    wind_generator: str = config_field(
+        "4_WIND", doc="participant generator name in the dataset")
+    wind_pmax: float = config_field(
+        120.0, bounds=(0.0, None), doc="wind capacity MW")
+    battery_energy_capacity: float = config_field(
+        60.0, bounds=(0.0, None), doc="battery energy MWh")
+    battery_pmax: float = config_field(
+        15.0, bounds=(0.0, None), doc="battery power MW")
+    n_scenario: int = config_field(
+        3, bounds=(1, None), doc="bidding price scenarios")
+    participation_mode: str = config_field(
+        "Bid", choices=("Bid", "SelfSchedule"),
+        doc="market participation mode")
+    reserve_factor: float = config_field(0.0, bounds=(0.0, 1.0),
+                                         doc="market reserve factor")
+    start_date: str = config_field("2020-07-10", doc="simulation start")
+    num_days: int = config_field(2, bounds=(1, None),
+                                 doc="days to simulate")
+    day_ahead_horizon: int = config_field(
+        48, bounds=(24, None), doc="bidder DA horizon (reference "
+        "run_double_loop.py:228 uses 48)")
+    real_time_horizon: int = config_field(
+        4, bounds=(1, None), doc="bidder RT horizon (reference :229)")
+    tracking_horizon: int = config_field(
+        4, bounds=(1, None), doc="tracker horizon (reference :264-297)")
+    output_dir: Optional[str] = config_field(
+        None, doc="results directory (default sim_<id>_results)")
+    platform: Optional[str] = config_field(
+        None, choices=("cpu", "tpu"),
+        doc="force a JAX platform (cpu when the accelerator tunnel is "
+        "down; must be set before any jax op)")
+
+    def __post_init__(self):
+        if self.real_time_horizon > self.day_ahead_horizon:
+            raise ConfigError(
+                "real_time_horizon cannot exceed day_ahead_horizon")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--sim_id", type=int, default=0)
-    p.add_argument("--data_path", type=str, required=True)
-    p.add_argument("--wind_generator", type=str, default="4_WIND")
-    p.add_argument("--wind_pmax", type=float, default=120.0)
-    p.add_argument("--battery_energy_capacity", type=float, default=60.0)
-    p.add_argument("--battery_pmax", type=float, default=15.0)
-    p.add_argument("--n_scenario", type=int, default=3)
-    p.add_argument(
-        "--participation_mode",
-        type=str,
-        default="Bid",
-        choices=["Bid", "SelfSchedule"],
-    )
-    p.add_argument("--reserve_factor", type=float, default=0.0)
-    p.add_argument("--start_date", type=str, default="2020-07-10")
-    p.add_argument("--num_days", type=int, default=2)
-    p.add_argument("--output_dir", type=str, default=None)
-    p.add_argument(
-        "--platform",
-        type=str,
-        default=None,
-        choices=[None, "cpu", "tpu"],
-        help="force a JAX platform (cpu when the accelerator tunnel is "
-        "down; must be set before any jax op)",
-    )
+    DoubleLoopOptions.add_cli_args(p)
     return p
 
 
 def run_double_loop(options) -> dict:
+    if isinstance(options, argparse.Namespace):
+        options = DoubleLoopOptions.from_cli(options)  # validates, incl.
+        # the required data_path
     if getattr(options, "platform", None):
         import jax
 
@@ -129,14 +159,16 @@ def run_double_loop(options) -> dict:
 
     bidder = bidder_cls(
         bidding_model_object=make_mp(),
-        day_ahead_horizon=48,
-        real_time_horizon=4,
+        day_ahead_horizon=options.day_ahead_horizon,
+        real_time_horizon=options.real_time_horizon,
         n_scenario=options.n_scenario,
         forecaster=backcaster,
     )
-    tracker = Tracker(tracking_model_object=make_mp(), tracking_horizon=4)
+    tracker = Tracker(tracking_model_object=make_mp(),
+                      tracking_horizon=options.tracking_horizon)
     projection_tracker = Tracker(
-        tracking_model_object=make_mp(), tracking_horizon=4
+        tracking_model_object=make_mp(),
+        tracking_horizon=options.tracking_horizon,
     )
     coordinator = DoubleLoopCoordinator(bidder, tracker, projection_tracker)
 
@@ -144,8 +176,8 @@ def run_double_loop(options) -> dict:
     sim = MarketSimulator(
         case,
         output_dir=output_dir,
-        sced_horizon=4,
-        ruc_horizon=48,
+        sced_horizon=options.real_time_horizon,
+        ruc_horizon=options.day_ahead_horizon,
         reserve_factor=options.reserve_factor,
         coordinator=coordinator,
     )

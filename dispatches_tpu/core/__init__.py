@@ -1,4 +1,5 @@
 from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec, Port
 from dispatches_tpu.core.compile import CompiledNLP
+from dispatches_tpu.core.config import ConfigError, config, config_field
 
-__all__ = ["Flowsheet", "UnitModel", "VarSpec", "Port", "CompiledNLP"]
+__all__ = ["Flowsheet", "UnitModel", "VarSpec", "Port", "CompiledNLP", "ConfigError", "config", "config_field"]

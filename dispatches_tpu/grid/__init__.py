@@ -23,6 +23,7 @@ from dispatches_tpu.grid.coordinator import (
 )
 from dispatches_tpu.grid.market import (
     MarketCase,
+    MarketOptions,
     MarketSimulator,
     load_rts_gmlc_case,
     solve_unit_commitment,
@@ -39,6 +40,7 @@ __all__ = [
     "DoubleLoopCoordinator",
     "convert_marginal_costs_to_actual_costs",
     "MarketCase",
+    "MarketOptions",
     "MarketSimulator",
     "load_rts_gmlc_case",
     "solve_unit_commitment",

@@ -35,10 +35,30 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from dispatches_tpu.core.config import config, config_field
 from dispatches_tpu.core.graph import Flowsheet
 from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
 
 N_SEG = 3  # thermal cost curves: RTS heat-rate tables carry 3 increments
+
+
+@config
+class MarketOptions:
+    """Typed simulation options (the Prescient options-dict tier of the
+    reference, ``run_double_loop.py:309-332`` — here one validated
+    config, SURVEY.md §5)."""
+
+    sced_horizon: int = config_field(
+        4, bounds=(1, None), doc="SCED lookahead hours (reference "
+        "sced_horizon=4)")
+    ruc_horizon: int = config_field(
+        48, bounds=(24, None), doc="RUC commitment horizon with cross-day "
+        "state (>= 24: the settlement loop clears 24 hours per simulated "
+        "day; reference ruc_horizon=48)")
+    reserve_factor: float = config_field(
+        0.0, bounds=(0.0, 1.0), doc="spinning-reserve fraction of load")
+    use_milp: bool = config_field(
+        True, doc="exact HiGHS MILP for the RUC (else LP-relax+repair)")
 
 
 @dataclass
@@ -696,24 +716,42 @@ class MarketSimulator:
         self,
         case: MarketCase,
         output_dir,
-        sced_horizon: int = 4,
-        ruc_horizon: int = 48,
-        reserve_factor: float = 0.0,
-        use_milp: bool = True,
+        sced_horizon: Optional[int] = None,
+        ruc_horizon: Optional[int] = None,
+        reserve_factor: Optional[float] = None,
+        use_milp: Optional[bool] = None,
         coordinator=None,
+        options: Optional[MarketOptions] = None,
     ):
+        # None = not passed, so an explicit kwarg equal to a config
+        # default is still detectable against options=
+        passed = {
+            k: v for k, v in {
+                "sced_horizon": sced_horizon,
+                "ruc_horizon": ruc_horizon,
+                "reserve_factor": reserve_factor,
+                "use_milp": use_milp,
+            }.items() if v is not None
+        }
+        if options is None:
+            # kwargs route through the same validated config tier
+            options = MarketOptions(**passed)
+        else:
+            conflicts = [k for k, v in passed.items()
+                         if v != getattr(options, k)]
+            if conflicts:
+                raise ValueError(
+                    f"conflicting MarketSimulator arguments: {conflicts} "
+                    "passed both as kwargs and via options="
+                )
+        self.options = options
         self.case = case
         self.output_dir = Path(output_dir)
         self.output_dir.mkdir(parents=True, exist_ok=True)
-        self.sced_horizon = int(sced_horizon)
-        self.ruc_horizon = int(ruc_horizon)
-        if self.ruc_horizon < 24:
-            raise ValueError(
-                "ruc_horizon must be >= 24: the settlement loop clears "
-                "24 hours per simulated day, so a shorter commitment "
-                "horizon would silently drop settlement hours")
-        self.reserve_factor = float(reserve_factor)
-        self.use_milp = use_milp
+        self.sced_horizon = options.sced_horizon
+        self.ruc_horizon = options.ruc_horizon
+        self.reserve_factor = options.reserve_factor
+        self.use_milp = options.use_milp
         self.coordinator = coordinator
         pname = pbus = None
         if coordinator is not None:

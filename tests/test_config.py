@@ -1,0 +1,136 @@
+"""Typed config layer (core/config.py): the single validated tier that
+replaces the reference's three config surfaces — IDAES ConfigBlock unit
+options, case-study parameter modules, and script argparse + Prescient
+options dicts (SURVEY.md §5, ref ``run_double_loop.py:40-104,309-332``).
+"""
+
+import argparse
+from typing import Optional
+
+import pytest
+
+from dispatches_tpu.core import ConfigError, config, config_field
+
+
+@config
+class _Inner:
+    tol: float = config_field(1e-6, bounds=(0.0, 1.0))
+
+
+@config
+class _Demo:
+    n: int = config_field(4, bounds=(1, 64), doc="count")
+    mode: str = config_field("fast", choices=("fast", "exact"))
+    label: Optional[str] = config_field(None)
+    flag: bool = config_field(True)
+    inner: _Inner = config_field(cli=True, factory=_Inner)
+
+
+def test_defaults_and_replace():
+    d = _Demo()
+    assert d.n == 4 and d.mode == "fast" and d.inner.tol == 1e-6
+    d2 = d.replace(n=8)
+    assert d2.n == 8 and d.n == 4  # frozen + functional update
+
+
+def test_coercion():
+    d = _Demo(n="16", flag="false")
+    assert d.n == 16 and d.flag is False
+
+
+@pytest.mark.parametrize("kw", [
+    {"n": 0},                # below bound
+    {"n": 65},               # above bound
+    {"n": "4.5"},            # not an integer
+    {"mode": "slow"},        # not a choice
+    {"flag": "maybe"},       # not a bool
+    {"inner": {"tol": 2.0}},  # nested bound
+])
+def test_validation_errors(kw):
+    with pytest.raises(ConfigError):
+        _Demo(**kw)
+
+
+def test_dict_json_roundtrip():
+    d = _Demo(n=7, label="x", inner={"tol": 0.5})
+    assert isinstance(d.inner, _Inner) and d.inner.tol == 0.5
+    back = _Demo.from_dict(d.to_dict())
+    assert back == d
+    assert _Demo.from_json(d.to_json()) == d
+
+
+def test_from_dict_rejects_unknown_keys():
+    with pytest.raises(ConfigError):
+        _Demo.from_dict({"n": 4, "bogus": 1})
+
+
+def test_cli_roundtrip():
+    parser = argparse.ArgumentParser()
+    _Demo.add_cli_args(parser)
+    ns = parser.parse_args(
+        ["--n", "9", "--mode", "exact", "--inner.tol", "0.25"])
+    d = _Demo.from_cli(ns)
+    assert d.n == 9 and d.mode == "exact" and d.inner.tol == 0.25
+
+
+def test_coercion_bad_int_string_is_config_error():
+    with pytest.raises(ConfigError, match="n"):
+        _Demo(n="abc")
+
+
+def test_from_json_missing_path():
+    from pathlib import Path
+
+    with pytest.raises(FileNotFoundError):
+        _Demo.from_json(Path("/tmp/definitely_missing_config.json"))
+
+
+def test_market_options_tier():
+    """MarketSimulator kwargs route through the validated tier."""
+    from dispatches_tpu.grid import MarketOptions
+
+    with pytest.raises(ConfigError):
+        MarketOptions(ruc_horizon=12)  # settlement needs >= 24 h
+    assert MarketOptions(ruc_horizon=96).ruc_horizon == 96  # no upper cap
+    assert MarketOptions(sced_horizon="8").sced_horizon == 8
+
+
+def test_market_simulator_rejects_conflicting_options(tmp_path):
+    from dispatches_tpu.grid import MarketOptions
+    from dispatches_tpu.grid.market import MarketCase, MarketSimulator
+    import numpy as np
+    import pandas as pd
+
+    case = MarketCase(
+        buses=["b"], thermals=[], renewables=[],
+        load_da=np.zeros((24, 1)), load_rt=np.zeros((24, 1)),
+        ptdf=np.zeros((0, 1)), line_limits=np.zeros(0), line_names=[],
+        start_timestamp=pd.Timestamp("2020-07-10"),
+    )
+    with pytest.raises(ValueError, match="conflicting"):
+        MarketSimulator(case, output_dir=tmp_path, sced_horizon=8,
+                        options=MarketOptions())
+    # an explicit kwarg equal to the config default still conflicts
+    with pytest.raises(ValueError, match="use_milp"):
+        MarketSimulator(case, output_dir=tmp_path, use_milp=True,
+                        options=MarketOptions(use_milp=False))
+
+
+def test_double_loop_options_tier():
+    from dispatches_tpu.case_studies.renewables.run_double_loop import (
+        DoubleLoopOptions,
+        build_parser,
+    )
+
+    ns = build_parser().parse_args(["--data_path", "x", "--num_days", "3"])
+    opts = DoubleLoopOptions.from_cli(ns)
+    assert opts.num_days == 3 and opts.day_ahead_horizon == 48
+    with pytest.raises(ConfigError):
+        DoubleLoopOptions(data_path="x", real_time_horizon=30,
+                          day_ahead_horizon=24)
+    # missing --data_path is an argparse usage error (required=True)
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--num_days", "3"])
+    # constructing without the required field fails (no default exists)
+    with pytest.raises(TypeError, match="data_path"):
+        DoubleLoopOptions()
