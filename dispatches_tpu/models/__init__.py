@@ -13,6 +13,8 @@ from dispatches_tpu.models.elec_splitter import ElectricalSplitter
 from dispatches_tpu.models.wind_power import (
     WindPower,
     atb2018_capacity_factors,
+    sam_pdf_capacity_factors,
+    sam_weibull_capacity_factors,
     sam_windpower_capacity_factors,
 )
 from dispatches_tpu.models.solar_pv import SolarPV
@@ -32,6 +34,8 @@ __all__ = [
     "ElectricalSplitter",
     "WindPower",
     "atb2018_capacity_factors",
+    "sam_pdf_capacity_factors",
+    "sam_weibull_capacity_factors",
     "sam_windpower_capacity_factors",
     "SolarPV",
     "PEMElectrolyzer",

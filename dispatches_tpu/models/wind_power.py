@@ -47,25 +47,45 @@ def atb2018_capacity_factors(wind_speeds_m_s: Sequence[float]) -> np.ndarray:
 #: PySAM Windpower pipeline reconstruction (``wind_power.py:148-185``:
 #: WindpowerSingleowner defaults, single ATB 2018 turbine, per-timestep
 #: deterministic speed fed as a near-delta Weibull, k=100).  PySAM is
-#: not available in this environment to diff against, so two candidate
-#: reconstructions were CALIBRATED against the reference's RE
-#: regression triple (``test_RE_flowsheet.py:124-129``: NPV
-#: 1,001,068,228 / battery 1,326,779 kW / revenue 168,691,601 on the
-#: vendored SRW + RTS price data) and VALIDATED on all three anchors:
+#: not available in this environment to diff against, so candidate
+#: reconstructions were CALIBRATED/VALIDATED against every PySAM number
+#: the reference vendors:
 #:
-#: * Gaussian power-curve smear (sigma = TI x speed) + flat loss —
-#:   reproduces ALL THREE anchors to <1e-6 rel with (TI, loss) =
-#:   (0.07358, 0.900701).  This is the default pipeline.
-#: * SSC-style Weibull-CDF binning over the 1 m/s power-curve grid
-#:   (``sam_weibull_capacity_factors``) — with its loss refit to the
-#:   NPV anchor (0.81867) it still misses revenue by 1.1% and the
-#:   optimal battery by 1.8%, i.e. the coarse right-edge binning does
-#:   NOT match PySAM's effective smearing.  Kept as a documented
-#:   alternative for Weibull-resource workflows.
+#: (a) the RE regression triple (``test_RE_flowsheet.py:124-129``: NPV
+#:     1,001,068,228 / battery 1,326,779 kW / revenue 168,691,601 on
+#:     the vendored SRW + RTS price data);
+#: (b) the Wind_Power unit anchors (``test_wind_power.py:49,78``):
+#:     CF = 0.575501 for a delta PDF at 10 m/s (resource-distribution
+#:     path) and CF = 0.6016678 for the Weibull k=100 path at 10 m/s.
+#:
+#: Findings of the discrimination study (round 4):
+#:
+#: * Gaussian power-curve smear (sigma = TI x speed) + flat loss
+#:   reproduces ALL THREE triple anchors to <1e-6 rel with (TI, loss)
+#:   = (0.07358, 0.900701).  This is the default case-study pipeline.
+#: * Every SSC-structural Weibull-CDF binning (left/right/trapezoid
+#:   power weighting on 1.0/0.5/0.25/0.125 m/s grids, one flat loss
+#:   calibrated to unit anchor (b)) misses the triple by 2.5-15% —
+#:   and conversely the triple-exact Gaussian puts CF(10 m/s) at
+#:   0.6283, +4.4% off anchor (b).  No single flat-loss power-curve
+#:   pipeline satisfies both anchor sets, indicating the reference's
+#:   unit anchors and case-study regressions were locked in with
+#:   different PySAM releases.  The closest structural match to the
+#:   unit anchor is LEFT-edge CDF binning on a 0.25 m/s resampled
+#:   curve: raw CF(10) = 0.667441, whose calibrated loss 0.901455
+#:   agrees with the triple-fit loss 0.900701 to 0.08% — that variant
+#:   is shipped as :func:`sam_weibull_capacity_factors` and reproduces
+#:   the reference's own ``test_windpower2`` anchor exactly (its
+#:   aggregate deviation on the triple is -2.5% NPV, documented).
+#: * The resource-distribution (PDF) path is plain power-curve
+#:   interpolation times a flat 0.834446 multiplier (anchor (b) delta
+#:   case) — :func:`sam_pdf_capacity_factors`.
 SAM_TURBULENCE_INTENSITY = 0.07358
 SAM_LOSS_FACTOR = 0.900701
 SAM_WEIBULL_K = 100.0
-SAM_WEIBULL_LOSS_FACTOR = 0.81867  # NPV-anchor refit for the binned path
+SAM_WEIBULL_BIN_M_S = 0.25
+SAM_WEIBULL_LOSS_FACTOR = 0.901455  # unit-anchor-exact for left-edge 0.25
+SAM_PDF_LOSS_FACTOR = 0.834446      # test_wind_power.py:49 anchor
 
 
 def sam_windpower_capacity_factors(
@@ -96,24 +116,43 @@ def sam_weibull_capacity_factors(
     wind_speeds_m_s: Sequence[float],
     weibull_k: float = SAM_WEIBULL_K,
     loss_factor: float = SAM_WEIBULL_LOSS_FACTOR,
+    bin_m_s: float = SAM_WEIBULL_BIN_M_S,
 ) -> np.ndarray:
-    """SSC-style Weibull capacity factors (``lib_windwatts.cpp``
-    ``turbine_output_using_weibull`` structure): per timestep, scale
+    """SSC-structural Weibull capacity factors (``lib_windwatts.cpp``
+    ``turbine_output_using_weibull`` shape): per timestep, scale
     ``lambda = v / Gamma(1 + 1/k)``, bin probability ``CDF(ws_i) -
-    CDF(ws_{i-1})`` over the power curve's 1 m/s grid, expected power
-    ``sum(bin_i * P_i)`` (right-edge power), normalized by rated power,
-    times a flat loss factor.  See the module note for its measured
-    anchor deviations vs the default Gaussian-smear pipeline."""
+    CDF(ws_{i-1})`` over the power curve resampled to a ``bin_m_s``
+    grid, expected power from the bin's left-edge output, normalized by
+    rated power, times a flat loss factor.  With the defaults this
+    reproduces the reference's ``test_windpower2`` PySAM anchor
+    (CF(10 m/s) = 0.6016678) exactly; see the module note for its
+    measured aggregate deviation vs the default Gaussian-smear
+    pipeline and the version-skew evidence."""
     from scipy.special import gammaln
 
     v = np.asarray(wind_speeds_m_s, dtype=np.float64)[:, None]
     lam = np.maximum(v, 1e-9) / np.exp(gammaln(1.0 + 1.0 / weibull_k))
-    ws = np.arange(len(ATB2018_POWERCURVE_KW), dtype=np.float64)[None, :]
+    ws = np.arange(0.0, 40.0 + bin_m_s / 2, bin_m_s)
+    grid = np.arange(len(ATB2018_POWERCURVE_KW), dtype=np.float64)
+    P = np.interp(ws, grid, ATB2018_POWERCURVE_KW, left=0.0, right=0.0)
     with np.errstate(over="ignore"):  # pow overflow -> CDF saturates at 1
-        cdf = 1.0 - np.exp(-np.power(ws / lam, weibull_k))
-    bins = np.diff(cdf, axis=1)  # P(ws_{i-1} < V <= ws_i), i = 1..
-    mean_kw = bins @ ATB2018_POWERCURVE_KW[1:]
+        cdf = 1.0 - np.exp(-np.power(ws[None, :] / lam, weibull_k))
+    bins = np.diff(cdf, axis=1)  # P(ws_{i-1} < V <= ws_i)
+    mean_kw = bins @ P[:-1]  # left-edge power
     return mean_kw / ATB2018_RATED_KW * loss_factor
+
+
+def sam_pdf_capacity_factors(
+    wind_speeds_m_s: Sequence[float],
+    loss_factor: float = SAM_PDF_LOSS_FACTOR,
+) -> np.ndarray:
+    """Capacity factors for the reference's resource-probability-density
+    path with a delta PDF per timestep (``wind_power.py:152-166``,
+    ``wind_resource_model_choice=2`` with one (speed, direction, 1.0)
+    bin): power-curve interpolation at the bin speed times the flat
+    SAM-default loss multiplier, which reproduces the reference's
+    ``test_windpower`` anchor (CF(10 m/s) = 0.575501) exactly."""
+    return atb2018_capacity_factors(wind_speeds_m_s) * loss_factor
 
 
 class WindPower(UnitModel):

@@ -136,6 +136,36 @@ def test_wind_powercurve_cf():
     )
 
 
+def test_wind_pdf_path_anchor():
+    """Reference ``test_wind_power.py::test_windpower`` PySAM anchor: a
+    delta resource PDF at 10 m/s gives CF 0.5755 and 28,775.06 kW on a
+    50 MW system (asserted there at rel 1e-2; exact here)."""
+    from dispatches_tpu.models import sam_pdf_capacity_factors
+
+    cf = float(sam_pdf_capacity_factors([10.0])[0])
+    assert cf == pytest.approx(0.5755, rel=1e-2)  # the reference assert
+    assert cf * 50000 == pytest.approx(28775.06, rel=1e-4)
+
+
+def test_wind_weibull_path_anchor():
+    """Reference ``test_wind_power.py::test_windpower2`` PySAM anchor:
+    the Weibull k=100 path at 10 m/s gives 30,083.39 kW on a 50 MW
+    system (asserted there at rel 1e-2; exact here).  The curve is
+    monotone through the power-curve ramp and hits the loss-scaled
+    plateau at rated speeds."""
+    from dispatches_tpu.models import sam_weibull_capacity_factors
+    from dispatches_tpu.models.wind_power import SAM_WEIBULL_LOSS_FACTOR
+
+    cf = float(sam_weibull_capacity_factors([10.0])[0])
+    assert cf * 50000 == pytest.approx(30083.39, rel=1e-2)  # ref assert
+    assert cf * 50000 == pytest.approx(30083.39, rel=1e-4)
+    speeds = np.arange(3.0, 14.0, 0.5)
+    curve = sam_weibull_capacity_factors(speeds)
+    assert np.all(np.diff(curve) > 0)
+    plateau = float(sam_weibull_capacity_factors([16.0])[0])
+    assert plateau == pytest.approx(SAM_WEIBULL_LOSS_FACTOR, rel=1e-3)
+
+
 def test_solar_pv():
     fs = Flowsheet(horizon=1)
     pv = SolarPV(fs, capacity_factors=[0.6])
