@@ -1,8 +1,8 @@
-"""Scenario-batch sharding of IPM solves over a device mesh."""
+"""Scenario-batch sharding of solver sweeps over a device mesh."""
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -29,19 +29,33 @@ def scenario_sharded_solver(
     max_iter: Optional[int] = None,
     axis: str = "scenario",
     full_result: bool = False,
+    solver=None,
 ):
     """Build ``solve(batched) -> objs`` where ``batched`` maps param (or
     fixed-var) names to arrays with a leading scenario axis; that axis is
-    sharded over ``mesh`` and each device runs its shard of IPM solves.
+    sharded over ``mesh`` and each device runs its shard of solves.
 
-    The batch size must be a multiple of the mesh size.  With
-    ``full_result=True`` the whole ``IPMResult`` pytree is returned
-    (x sharded along the scenario axis) instead of just objectives.
+    ``solver`` is any jit/vmap-compatible ``callable(params) -> result``
+    with an ``.obj`` field (e.g. ``make_pdlp_solver(nlp, ...)`` for the
+    LP fast path); by default a batched IPM is built from ``options`` /
+    ``max_iter``.
+
+    Batches that do not divide the mesh size are padded by repeating
+    the last scenario (the 366-day annual sweep on an 8-device mesh is
+    the canonical case) and the padding is trimmed from the result.
+    With ``full_result=True`` the solver's whole result pytree is
+    returned (leading axis = scenario) instead of just objectives.
     """
     if options is not None and max_iter is not None:
         raise ValueError("pass either options or max_iter, not both")
-    opts = options or IPMOptions(max_iter=max_iter or 100)
-    solver = make_ipm_solver(nlp, opts)
+    if solver is None:
+        opts = options or IPMOptions(max_iter=max_iter or 100)
+        solver = make_ipm_solver(nlp, opts)
+    elif options is not None or max_iter is not None:
+        raise ValueError(
+            "options/max_iter configure the default IPM; when passing a "
+            "prebuilt solver, configure it at construction instead"
+        )
 
     defaults = nlp.default_params()
     in_axes_p = {k: (0 if k in batched_keys else None) for k in defaults["p"]}
@@ -52,6 +66,8 @@ def scenario_sharded_solver(
 
     batch_sh = NamedSharding(mesh, P(axis))
     repl_sh = NamedSharding(mesh, P())
+    n_dev = int(mesh.shape[axis])  # the batch axis only needs to divide
+    # its own mesh dimension
 
     @jax.jit
     def _run(params):
@@ -59,14 +75,35 @@ def scenario_sharded_solver(
         return res if full_result else res.obj
 
     def solve(batched: Dict[str, np.ndarray]):
+        declared = set(batched_keys) | set(batched_fixed_keys)
+        sizes = set()
+        for k, v in batched.items():
+            shape = np.shape(v)  # no host copy for device arrays
+            if not shape:
+                raise ValueError(
+                    f"{k!r} must carry a leading scenario axis; got a "
+                    "scalar"
+                )
+            sizes.add(shape[0])
+        if len(sizes) > 1:
+            raise ValueError(
+                f"inconsistent scenario-batch sizes: {sorted(sizes)}"
+            )
+        n_scen = sizes.pop() if sizes else n_dev
+        pad = (-n_scen) % n_dev
+
         p = dict(defaults["p"])
         f = dict(defaults["fixed"])
         for k, vals in batched.items():
-            if k not in set(batched_keys) | set(batched_fixed_keys):
+            if k not in declared:
                 raise KeyError(
                     f"{k!r} was not declared in batched_keys at build time"
                 )
             arr = jnp.asarray(vals)
+            if pad:  # repeat the last scenario to fill the mesh evenly
+                arr = jnp.concatenate(
+                    [arr, jnp.repeat(arr[-1:], pad, axis=0)]
+                )
             if k in p:
                 p[k] = jax.device_put(arr, batch_sh)
             elif k in f:
@@ -79,6 +116,9 @@ def scenario_sharded_solver(
         for k in list(f.keys()):
             if k not in batched:
                 f[k] = jax.device_put(jnp.asarray(f[k]), repl_sh)
-        return _run({"p": p, "fixed": f})
+        out = _run({"p": p, "fixed": f})
+        if pad:
+            out = jax.tree_util.tree_map(lambda a: a[:n_scen], out)
+        return out
 
     return solve
