@@ -1,4 +1,8 @@
 from dispatches_tpu.solvers.ipm import IPMOptions, IPMResult, make_ipm_solver, solve_nlp
+from dispatches_tpu.solvers.pdlp_batch import (
+    BatchPDLPOptions,
+    make_pdlp_batch_solver,
+)
 from dispatches_tpu.solvers.pdlp import (
     LPResult,
     PDLPOptions,
@@ -16,5 +20,7 @@ __all__ = [
     "PDLPOptions",
     "make_lp_data",
     "make_pdlp_solver",
+    "BatchPDLPOptions",
+    "make_pdlp_batch_solver",
     "SolverFactory",
 ]

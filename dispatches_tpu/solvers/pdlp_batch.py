@@ -1,0 +1,395 @@
+"""Batch-native PDLP: one program solves a whole LMP-scenario batch.
+
+``make_pdlp_solver`` (pdlp.py) is a per-scenario solver lifted over the
+batch with ``jax.vmap`` — correct, but the hot inner sweep then lowers
+to one XLA while-loop whose per-iteration state (x, z and the running
+averages for every lane) round-trips HBM on every PDHG step.  This
+module provides the batch-first formulation: the scenario axis is an
+explicit leading dimension, the two PDHG matvecs become (B, m) @ (m, n)
+matmuls on the MXU, and the ``check_every``-step sweep is a single
+fused **Pallas kernel** that keeps the equilibrated matrices AND the
+per-lane iterates resident in VMEM for the whole sweep (HBM sees one
+read and one write of the state per sweep instead of one per step).
+
+The restart/termination logic between sweeps is identical to pdlp.py's
+(averaging, PDLP sufficient-decay + artificial restarts, primal-weight
+rebalancing, best-iterate stall exit), evaluated vectorized over lanes.
+
+``sweep="pallas"`` requires a TPU (or ``interpret=True`` for CPU
+correctness tests); ``sweep="xla"`` is the portable fallback with the
+same batch layout.  Cite: reference CBC subprocess LP path
+(``wind_battery_LMP.py:255``); SURVEY.md §2.6/§2.7.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.solvers.pdlp import (
+    LPResult,
+    PDLPOptions,
+    _power_norm,
+    _ruiz_equilibrate,
+    make_lp_data,
+)
+
+
+@dataclass(frozen=True)
+class BatchPDLPOptions(PDLPOptions):
+    sweep: str = "auto"      # "pallas" | "xla" | "auto" (pallas on TPU)
+    lanes_per_block: int = 256   # pallas grid: scenario lanes per program
+    interpret: bool = False      # pallas interpreter (CPU tests)
+
+
+def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
+                     interpret):
+    """Build ``sweep(x, z, xs, zs, c, b, tau, sig) -> (x, z, xs, zs)``
+    running ``k`` PDHG steps fused in one Pallas kernel.
+
+    Layout: lane-major batches (B, n) / (B, m); ``Ah`` (m, n) and
+    ``AhT`` (n, m) are broadcast to every program, so the dual->primal
+    product is ``z @ Ah`` and the primal->dual one ``v @ AhT`` — both
+    row-major MXU matmuls.  Static data (bounds, equality mask) is
+    baked into the kernel as constants."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, n = Ah.shape
+    dtype = Ah.dtype
+    lb_row = jnp.asarray(lb, dtype)[None, :]
+    ub_row = jnp.asarray(ub, dtype)[None, :]
+    eq_row = jnp.asarray(is_eq_f, dtype)[None, :]  # 1.0 eq / 0.0 ineq
+
+    def kernel(Ah_ref, AhT_ref, lb_ref, ub_ref, eq_ref,
+               c_ref, b_ref, tau_ref, sig_ref,
+               x_ref, z_ref, xs_ref, zs_ref,
+               x_out, z_out, xs_out, zs_out):
+        A = Ah_ref[:]
+        AT = AhT_ref[:]
+        lb_r = lb_ref[:]
+        ub_r = ub_ref[:]
+        eq_r = eq_ref[:]
+        c = c_ref[:]
+        b = b_ref[:]
+        tau = tau_ref[:]
+        sig = sig_ref[:]
+
+        # full-f32 MXU passes: default precision runs bf16 input passes,
+        # which floor the PDHG fixed point at ~1e-3 relative error
+        # (measured on the XLA path, pdlp.py:143-147) — far above tol
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=dtype,
+        )
+
+        def body(_, carry):
+            x, z, xs, zs = carry
+            grad = c + dot(z, A)
+            xn = jnp.clip(x - tau * grad, lb_r, ub_r)
+            ax = dot(2.0 * xn - x, AT)
+            zt = z + sig * (ax - b)
+            zn = eq_r * zt + (1.0 - eq_r) * jnp.maximum(zt, 0.0)
+            return xn, zn, xs + xn, zs + zn
+
+        x, z, xs, zs = jax.lax.fori_loop(
+            0, k, body, (x_ref[:], z_ref[:], xs_ref[:], zs_ref[:])
+        )
+        x_out[:] = x
+        z_out[:] = z
+        xs_out[:] = xs
+        zs_out[:] = zs
+
+    def sweep(x, z, xs, zs, c, b, tau, sig):
+        B0 = x.shape[0]
+        lb_blk = min(lanes_per_block, B0)
+        pad = (-B0) % lb_blk
+        if pad:  # zero lanes are inert (tau=sig=0 -> fixed point)
+            zp = lambda a: jnp.concatenate(  # noqa: E731
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+            x, z, xs, zs = zp(x), zp(z), zp(xs), zp(zs)
+            c, b, tau, sig = zp(c), zp(b), zp(tau), zp(sig)
+        B = B0 + pad
+        grid = (B // lb_blk,)
+
+        def lane_spec(width):
+            return pl.BlockSpec((lb_blk, width), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+
+        full = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+        out_shapes = [
+            jax.ShapeDtypeStruct((B, n), dtype),
+            jax.ShapeDtypeStruct((B, m), dtype),
+            jax.ShapeDtypeStruct((B, n), dtype),
+            jax.ShapeDtypeStruct((B, m), dtype),
+        ]
+        call = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                full((m, n), lambda i: (0, 0)),
+                full((n, m), lambda i: (0, 0)),
+                full((1, n), lambda i: (0, 0)),   # lb
+                full((1, n), lambda i: (0, 0)),   # ub
+                full((1, m), lambda i: (0, 0)),   # eq mask
+                lane_spec(n),   # c
+                lane_spec(m),   # b
+                lane_spec(1),   # tau
+                lane_spec(1),   # sig
+                lane_spec(n),   # x
+                lane_spec(m),   # z
+                lane_spec(n),   # xs
+                lane_spec(m),   # zs
+            ],
+            out_specs=[lane_spec(n), lane_spec(m), lane_spec(n),
+                       lane_spec(m)],
+            out_shape=out_shapes,
+            interpret=interpret,
+        )
+        out = call(Ah, AhT, lb_row, ub_row, eq_row, c, b, tau, sig,
+                   x, z, xs, zs)
+        if pad:
+            out = tuple(a[:B0] for a in out)
+        return out
+
+    return sweep
+
+
+def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
+                           lp_data=None):
+    """Build ``solver(batched_params) -> LPResult`` where every leaf of
+    ``batched_params`` that varies per scenario carries a leading batch
+    axis (broadcast leaves may stay unbatched); the result's fields all
+    carry the batch axis.
+
+    ``batched_params`` follows ``nlp.default_params()`` structure; the
+    per-scenario (c, b) are derived inside the trace exactly as in
+    pdlp.py (one residual eval at x=0 + one objective gradient, vmapped
+    over the batch)."""
+    opt = options
+    dtype = jnp.dtype(opt.dtype)
+    data = lp_data if lp_data is not None else make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    m_eq, m_in = K.shape[0], G.shape[0]
+    n = nlp.n
+    m = m_eq + m_in
+
+    A = np.vstack([K, G]) if m_in else K
+    dr, dc = _ruiz_equilibrate(A, opt.ruiz_iters)
+    Ah = dr[:, None] * A * dc[None, :]
+    norm_A = max(_power_norm(Ah), 1e-12)
+
+    Ah_j = jnp.asarray(Ah, dtype)
+    AhT_j = jnp.asarray(Ah.T, dtype)
+    dr_j = jnp.asarray(dr, dtype)
+    dc_j = jnp.asarray(dc, dtype)
+    lb_h = jnp.asarray(data["lb"] / dc, dtype)
+    ub_h = jnp.asarray(data["ub"] / dc, dtype)
+    is_eq = jnp.concatenate([jnp.ones(m_eq, bool), jnp.zeros(m_in, bool)])
+    is_eq_f = is_eq.astype(dtype)
+    inv_step = jnp.asarray(1.0 / norm_A, dtype)
+    _prec = jax.lax.Precision.HIGHEST
+
+    use_pallas = opt.sweep == "pallas" or (
+        opt.sweep == "auto" and jax.devices()[0].platform == "tpu"
+    )
+    if use_pallas:
+        sweep = _pallas_sweep_fn(Ah_j, AhT_j, lb_h, ub_h, is_eq_f,
+                                 opt.check_every, opt.lanes_per_block,
+                                 opt.interpret)
+    else:
+        def sweep(x, z, xs, zs, c, b, tau, sig):
+            def body(carry, _):
+                x, z, xs, zs = carry
+                grad = c + jnp.matmul(z, Ah_j, precision=_prec)
+                xn = jnp.clip(x - tau * grad, lb_h[None, :], ub_h[None, :])
+                ax = jnp.matmul(2.0 * xn - x, AhT_j, precision=_prec)
+                zt = z + sig * (ax - b)
+                zn = jnp.where(is_eq[None, :], zt, jnp.clip(zt, 0.0, None))
+                return (xn, zn, xs + xn, zs + zn), None
+
+            (x, z, xs, zs), _ = jax.lax.scan(
+                body, (x, z, xs, zs), None, length=opt.check_every
+            )
+            return x, z, xs, zs
+
+    def _rhs_one(params):
+        x0 = jnp.zeros(n)
+        c = jax.grad(lambda x: nlp.objective(x, params))(x0)
+        q = -nlp.eq(x0, params)
+        h = -nlp.ineq(x0, params)
+        b = jnp.concatenate([q, h]) if m_in else q
+        return (c * dc_j).astype(dtype), (b * dr_j).astype(dtype)
+
+    def _inf_rows(v):
+        return jnp.max(jnp.abs(v), axis=-1) if v.shape[-1] else jnp.zeros(
+            v.shape[0], dtype)
+
+    def _kkt_errors(x, z, c, b):
+        """Per-lane relative primal/dual/gap errors (batched transcription
+        of pdlp.py:_kkt_errors)."""
+        ax = jnp.matmul(x, AhT_j, precision=_prec)
+        viol = jnp.where(is_eq[None, :], jnp.abs(ax - b),
+                         jnp.maximum(ax - b, 0.0))
+        pr = _inf_rows(viol) / (1.0 + _inf_rows(b))
+        r = c + jnp.matmul(z, Ah_j, precision=_prec)
+        rd = r - jnp.where(
+            r > 0,
+            jnp.where(jnp.isfinite(lb_h)[None, :], r, 0.0),
+            jnp.where(jnp.isfinite(ub_h)[None, :], r, 0.0),
+        )
+        du = _inf_rows(rd) / (1.0 + _inf_rows(c))
+        pobj = jnp.sum(c * x, axis=-1)
+        lb_fin = jnp.where(jnp.isfinite(lb_h), lb_h, 0.0)
+        ub_fin = jnp.where(jnp.isfinite(ub_h), ub_h, 0.0)
+        dobj = -jnp.sum(b * z, axis=-1) + jnp.sum(
+            jnp.clip(r, 0.0, None) * lb_fin[None, :]
+            + jnp.clip(r, None, 0.0) * ub_fin[None, :], axis=-1)
+        gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+        return pr, du, gap
+
+    def _err(x, z, c, b):
+        pr, du, gap = _kkt_errors(x, z, c, b)
+        return jnp.maximum(jnp.maximum(pr, du), gap)
+
+    def solver(batched_params) -> LPResult:
+        # batch axis = any leaf with one extra leading dim vs defaults;
+        # broadcast leaves vmap with axis None
+        defaults = nlp.default_params()
+
+        def axis_of(leaf, default_leaf):
+            extra = jnp.ndim(leaf) - np.ndim(default_leaf)
+            return 0 if extra == 1 else None
+
+        axes = jax.tree_util.tree_map(axis_of, batched_params, defaults)
+
+        def b_of(leaf, default_leaf):
+            extra = jnp.ndim(leaf) - np.ndim(default_leaf)
+            return leaf.shape[0] if extra == 1 else -1
+
+        sizes = {
+            s for s in jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(b_of, batched_params, defaults))
+            if s != -1
+        }
+        if not sizes:
+            raise ValueError("no leaf carries a leading batch axis")
+        if len(sizes) > 1:
+            raise ValueError(f"inconsistent batch sizes: {sorted(sizes)}")
+        B = sizes.pop()
+        c, b = jax.vmap(_rhs_one, in_axes=(axes,))(batched_params)
+
+        x = jnp.broadcast_to(jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h),
+                             (B, n))
+        z = jnp.zeros((B, m), dtype)
+
+        nb = jnp.linalg.norm(b, axis=-1)
+        nc = jnp.linalg.norm(c, axis=-1)
+        omega0 = jnp.where(
+            (nb > 0.0) & (nc > 0.0),
+            jnp.clip(nb / nc, 1e-4, 1e6),
+            jnp.asarray(opt.omega0, dtype),
+        ).astype(dtype)
+
+        e0 = _err(x, z, c, b)
+
+        def cond(s):
+            return jnp.logical_and(s["it"] < opt.max_iter,
+                                   ~jnp.all(s["done"]))
+
+        def step(s):
+            tau = (s["omega"] * inv_step)[:, None]
+            sig = (inv_step / s["omega"])[:, None]
+            x1, z1, xs, zs = sweep(s["x"], s["z"], s["xs"], s["zs"],
+                                   c, b, tau, sig)
+            k = s["k"] + opt.check_every
+            xa, za = xs / k[:, None], zs / k[:, None]
+            e_cur = _err(x1, z1, c, b)
+            e_avg = _err(xa, za, c, b)
+            use_avg = (e_avg < e_cur)[:, None]
+            xc = jnp.where(use_avg, xa, x1)
+            zc = jnp.where(use_avg, za, z1)
+            e_c = jnp.minimum(e_avg, e_cur)
+
+            sufficient = e_c <= opt.restart_beta * s["e_r"]
+            artificial = k >= jnp.maximum(0.36 * s["it"],
+                                          8 * opt.check_every)
+            do_restart = jnp.logical_or(sufficient, artificial)
+            dr_ = jnp.where(do_restart[:, None], xc, s["xr"])
+
+            dx = _inf_rows(xc - s["xr"])
+            dz = _inf_rows(zc - s["zr"])
+            omega_new = jnp.clip(
+                jnp.exp(0.5 * jnp.log(s["omega"])
+                        + 0.5 * jnp.log(jnp.maximum(dx, 1e-10)
+                                        / jnp.maximum(dz, 1e-10))),
+                1e-6, 1e8)
+            omega = jnp.where(do_restart, omega_new, s["omega"])
+            xr = dr_
+            zr = jnp.where(do_restart[:, None], zc, s["zr"])
+            e_r = jnp.where(do_restart, e_c, s["e_r"])
+            x_next = jnp.where(do_restart[:, None], xc, x1)
+            z_next = jnp.where(do_restart[:, None], zc, z1)
+
+            improved = e_c < 0.95 * s["e_b"]
+            new_best = e_c < s["e_b"]
+            e_b = jnp.where(new_best, e_c, s["e_b"])
+            xb = jnp.where(new_best[:, None], xc, s["xb"])
+            zb = jnp.where(new_best[:, None], zc, s["zb"])
+            stall = jnp.where(improved, 0, s["stall"] + 1)
+            floored = jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12)
+            done = jnp.logical_or(s["done"],
+                                  jnp.logical_or(e_b < opt.tol, floored))
+            it_next = s["it"] + opt.check_every
+            # per-lane iteration count, frozen when the lane finishes
+            it_done = jnp.where(jnp.logical_and(done, ~s["done"]),
+                                it_next, s["it_done"])
+            zero = do_restart[:, None]
+            return {
+                "x": x_next, "z": z_next,
+                "xs": jnp.where(zero, jnp.zeros_like(xs), xs),
+                "zs": jnp.where(zero, jnp.zeros_like(zs), zs),
+                "k": jnp.where(do_restart, 0, k),
+                "xr": xr, "zr": zr, "e_r": e_r, "omega": omega,
+                "it": it_next, "it_done": it_done,
+                "done": done, "e_b": e_b, "stall": stall,
+                "xb": xb, "zb": zb,
+            }
+
+        init = {
+            "x": x, "z": z,
+            "xs": jnp.zeros_like(x), "zs": jnp.zeros_like(z),
+            "k": jnp.zeros(B, jnp.int32),
+            "xr": x, "zr": z, "e_r": e0, "omega": omega0,
+            "it": jnp.asarray(0, jnp.int32),
+            "it_done": jnp.zeros(B, jnp.int32),
+            "done": e0 < opt.tol, "e_b": e0,
+            "stall": jnp.zeros(B, jnp.int32),
+            "xb": x, "zb": z,
+        }
+        out = jax.lax.while_loop(cond, step, init)
+        xb, zb = out["xb"], out["zb"]
+        pr, du, gap = _kkt_errors(xb, zb, c, b)
+        x_scaled = xb * dc_j[None, :]
+        obj = jax.vmap(
+            lambda xv, pv: nlp.user_objective(
+                xv.astype(jnp.result_type(float)), pv),
+            in_axes=(0, axes),
+        )(x_scaled, batched_params)
+        err = jnp.maximum(jnp.maximum(pr, du), gap)
+        return LPResult(
+            x=x_scaled, obj=obj, converged=err < opt.tol,
+            # per-lane count: frozen at convergence, global for lanes
+            # that ran out the clock
+            iters=jnp.where(out["done"], out["it_done"], out["it"]),
+            pr_err=pr, du_err=du, gap=gap,
+        )
+
+    return solver

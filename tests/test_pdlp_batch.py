@@ -1,0 +1,164 @@
+"""Batch-native PDLP (solvers/pdlp_batch.py): the batch-first PDHG
+formulation whose inner sweep is a fused Pallas TPU kernel (VMEM-
+resident state), with an XLA fallback sweep.  CPU tests validate (a)
+the Pallas kernel against the XLA sweep step-for-step in interpreter
+mode, and (b) the full batch solver against the per-scenario vmapped
+solver on the production wind+battery LP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.renewables.wind_battery_lmp import (
+    wind_battery_pricetaker_nlp,
+)
+from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
+from dispatches_tpu.solvers.pdlp import (
+    _power_norm,
+    _ruiz_equilibrate,
+    make_lp_data,
+)
+from dispatches_tpu.solvers.pdlp_batch import (
+    BatchPDLPOptions,
+    _pallas_sweep_fn,
+    make_pdlp_batch_solver,
+)
+
+T = 24
+
+
+@pytest.fixture(scope="module")
+def nlp():
+    rng = np.random.default_rng(0)
+    params_in = {
+        "wind_mw": 200.0, "batt_mw": 25.0,
+        "design_opt": False, "extant_wind": True,
+        "capacity_factors": np.clip(0.35 + 0.3 * rng.random(T), 0, 1),
+        "DA_LMPs": 30.0 + 20.0 * rng.random(T),
+    }
+    _, nlp = wind_battery_pricetaker_nlp(T, params_in)
+    return nlp
+
+
+def _lmp_batch(B, rng):
+    return 1e-3 * np.clip(
+        35.0 + 25.0 * rng.standard_normal((B, T)), 0.0, 200.0
+    )
+
+
+def test_batch_solver_matches_vmapped(nlp):
+    """Same fixed points as the per-scenario vmapped solver (different
+    but equivalent restart trajectories)."""
+    rng = np.random.default_rng(1)
+    B = 16
+    defaults = nlp.default_params()
+    batched = {"p": {**defaults["p"], "lmp": jnp.asarray(_lmp_batch(B, rng))},
+               "fixed": defaults["fixed"]}
+
+    bs = jax.jit(make_pdlp_batch_solver(
+        nlp, BatchPDLPOptions(tol=1e-6, dtype="float64", sweep="xla")))
+    rb = bs(batched)
+    assert np.asarray(rb.converged).mean() > 0.8
+
+    vs = jax.jit(jax.vmap(
+        make_pdlp_solver(nlp, PDLPOptions(tol=1e-6, dtype="float64")),
+        in_axes=({"p": {k: (0 if k == "lmp" else None)
+                        for k in defaults["p"]}, "fixed": None},)))
+    rv = vs(batched)
+    np.testing.assert_allclose(
+        np.asarray(rb.obj), np.asarray(rv.obj), rtol=5e-5)
+
+
+def test_pallas_sweep_matches_xla_sweep(nlp):
+    """The fused kernel reproduces the XLA scan sweep exactly
+    (interpreter mode on CPU; the same kernel runs compiled on TPU)."""
+    data = make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    A = np.vstack([K, G]) if G.shape[0] else K
+    dr, dc = _ruiz_equilibrate(A, 10)
+    Ah = (dr[:, None] * A * dc[None, :]).astype(np.float32)
+    m, n = Ah.shape
+    lb = (data["lb"] / dc).astype(np.float32)
+    ub = (data["ub"] / dc).astype(np.float32)
+    eq = np.concatenate(
+        [np.ones(K.shape[0]), np.zeros(G.shape[0])]).astype(np.float32)
+
+    rng = np.random.default_rng(2)
+    B, k = 8, 24
+    x = np.clip(rng.standard_normal((B, n)).astype(np.float32), lb, ub)
+    z = rng.standard_normal((B, m)).astype(np.float32)
+    xs = np.zeros_like(x)
+    zs = np.zeros_like(z)
+    c = 0.1 * rng.standard_normal((B, n)).astype(np.float32)
+    b = 0.1 * rng.standard_normal((B, m)).astype(np.float32)
+    tau = (0.5 / _power_norm(Ah) * np.ones((B, 1))).astype(np.float32)
+    sig = tau.copy()
+
+    sweep_p = _pallas_sweep_fn(jnp.asarray(Ah), jnp.asarray(Ah.T),
+                               lb, ub, eq, k, lanes_per_block=8,
+                               interpret=True)
+    out_p = sweep_p(*map(jnp.asarray, (x, z, xs, zs, c, b, tau, sig)))
+
+    def sweep_x(x, z, xs, zs, c, b, tau, sig):
+        def body(carry, _):
+            x, z, xs, zs = carry
+            grad = c + z @ jnp.asarray(Ah)
+            xn = jnp.clip(x - tau * grad, lb[None, :], ub[None, :])
+            zt = z + sig * (((2 * xn - x) @ jnp.asarray(Ah.T)) - b)
+            zn = jnp.where(eq[None, :] > 0.5, zt, jnp.clip(zt, 0.0, None))
+            return (xn, zn, xs + xn, zs + zn), None
+
+        (x, z, xs, zs), _ = jax.lax.scan(
+            body, (x, z, xs, zs), None, length=k)
+        return x, z, xs, zs
+
+    out_x = sweep_x(*map(jnp.asarray, (x, z, xs, zs, c, b, tau, sig)))
+    for got, want in zip(out_p, out_x):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_batch_axis_validation(nlp):
+    defaults = nlp.default_params()
+    solver = make_pdlp_batch_solver(
+        nlp, BatchPDLPOptions(sweep="xla", max_iter=40))
+    with pytest.raises(ValueError, match="batch axis"):
+        solver(defaults)  # nothing batched
+
+
+def test_pallas_sweep_pads_uneven_batch(nlp):
+    """Non-divisible lane batches pad with inert zero lanes and trim."""
+    data = make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    A = np.vstack([K, G]) if G.shape[0] else K
+    dr, dc = _ruiz_equilibrate(A, 10)
+    Ah = (dr[:, None] * A * dc[None, :]).astype(np.float32)
+    m, n = Ah.shape
+    lb = (data["lb"] / dc).astype(np.float32)
+    ub = (data["ub"] / dc).astype(np.float32)
+    eq = np.concatenate(
+        [np.ones(K.shape[0]), np.zeros(G.shape[0])]).astype(np.float32)
+
+    rng = np.random.default_rng(4)
+    B = 6  # lanes_per_block=4 -> pad 2
+    x = np.clip(rng.standard_normal((B, n)).astype(np.float32), lb, ub)
+    z = rng.standard_normal((B, m)).astype(np.float32)
+    args = (x, z, np.zeros_like(x), np.zeros_like(z),
+            0.1 * rng.standard_normal((B, n)).astype(np.float32),
+            0.1 * rng.standard_normal((B, m)).astype(np.float32),
+            (0.3 / _power_norm(Ah) * np.ones((B, 1))).astype(np.float32),
+            (0.3 / _power_norm(Ah) * np.ones((B, 1))).astype(np.float32))
+
+    sweep4 = _pallas_sweep_fn(jnp.asarray(Ah), jnp.asarray(Ah.T),
+                              lb, ub, eq, 8, lanes_per_block=4,
+                              interpret=True)
+    sweep6 = _pallas_sweep_fn(jnp.asarray(Ah), jnp.asarray(Ah.T),
+                              lb, ub, eq, 8, lanes_per_block=6,
+                              interpret=True)
+    out4 = sweep4(*map(jnp.asarray, args))
+    out6 = sweep6(*map(jnp.asarray, args))
+    for a, b_ in zip(out4, out6):
+        assert a.shape == b_.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-6, atol=1e-6)
