@@ -34,6 +34,7 @@ one assumption not pinned by the reference source."""
 from __future__ import annotations
 
 import math
+from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -574,28 +575,117 @@ def design_optimize(m: UscModel, heat_duty_mw: float = HEAT_DUTY_FIXED,
     )
 
 
+def _combo_summary(out) -> Dict:
+    return {
+        "salt": out["salt"], "source": out["source"],
+        "cost": float(out["cost"]), "hxc_area": float(out["hxc_area"]),
+        "salt_flow": float(out["salt_flow"]),
+        "salt_T_out": float(out["salt_T_out"]),
+        "converged": bool(out["converged"]),
+        "inner_failures": int(out["res"].inner_failures),
+    }
+
+
+def _run_combo(salt_name: str, source: str, load_from_file, maxiter: int,
+               verbose: int = 0) -> Dict:
+    m = build_charge_model(salt_name, source, load_from_file=load_from_file)
+    try:
+        return design_optimize(m, maxiter=maxiter, verbose=verbose)
+    except RuntimeError:
+        if load_from_file is None:
+            raise
+        # the loaded warm states come from the HP/solar integrated
+        # model; rebuild with the full initialization sweep instead
+        m = build_charge_model(salt_name, source, load_from_file=None)
+        return design_optimize(m, maxiter=maxiter, verbose=verbose)
+
+
+def isolated_json_call(call: str, identity: Dict,
+                       verbose: int = 0, timeout_s: float = 3600.0) -> Dict:
+    """Run ``<module-level call>`` in a fresh subprocess and return the
+    JSON summary it prints (per-scenario restart/fallback, SURVEY.md
+    §5): a crash or hang of one solve — e.g. an XLA:CPU compiler fault
+    on feature-mismatched hosts — degrades to an error-summary dict
+    instead of killing the caller.  The child pins the parent's JAX
+    backend (config forcing does not inherit via env); ``verbose``
+    forwards into the call and echoes the child's streams."""
+    import json
+    import subprocess
+    import sys
+
+    import jax
+
+    repo_root = str(Path(__file__).resolve().parents[3])
+    code = f"""
+import jax
+jax.config.update("jax_platforms", {jax.default_backend()!r})
+import json
+import sys
+sys.path.insert(0, {repo_root!r})
+{call}
+"""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {**identity, "converged": False,
+                "error": f"timed out after {timeout_s:.0f}s"}
+    if verbose:
+        print(r.stdout, end="")
+        print(r.stderr, end="")
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return {**identity, "converged": False,
+            "error": f"rc={r.returncode}: {r.stderr.strip()[-300:]}"}
+
+
+def _run_combo_isolated(salt_name: str, source: str, load_from_file,
+                        maxiter: int, verbose: int = 0) -> Dict:
+    lf = "None" if load_from_file is None else repr(str(load_from_file))
+    call = (
+        "from dispatches_tpu.case_studies.fossil import "
+        "storage_charge_design as cd\n"
+        f"out = cd._run_combo({salt_name!r}, {source!r}, {lf}, {maxiter}, "
+        f"verbose={verbose})\n"
+        "print(json.dumps(cd._combo_summary(out)))"
+    )
+    return isolated_json_call(
+        call, {"salt": salt_name, "source": source}, verbose=verbose)
+
+
 def run_design_study(combos: Optional[Tuple[Tuple[str, str], ...]] = None,
                      load_from_file=None, maxiter: int = 200,
-                     verbose: int = 0) -> Dict:
+                     verbose: int = 0, isolate: bool = False) -> Dict:
     """Enumerate the disjunct combinations and pick the minimum-cost
     design — the role of the reference's GDPopt RIC loop (``run_gdp``,
-    :2580-2607)."""
+    :2580-2607).  ``isolate=True`` runs each combo in a fresh
+    subprocess (summary dicts only, no live model objects) so one
+    combo's failure cannot take down the enumeration."""
     if combos is None:
         combos = tuple((s, src) for s in SALTS for src in SOURCES)
     results = []
     for salt_name, source in combos:
-        m = build_charge_model(salt_name, source,
-                               load_from_file=load_from_file)
-        try:
-            out = design_optimize(m, maxiter=maxiter, verbose=verbose)
-        except RuntimeError:
-            if load_from_file is None:
-                raise
-            # the loaded warm states come from the HP/solar integrated
-            # model; rebuild with the full initialization sweep instead
-            m = build_charge_model(salt_name, source, load_from_file=None)
-            out = design_optimize(m, maxiter=maxiter, verbose=verbose)
-        results.append(out)
-    feasible = [r for r in results if r["converged"]]
+        if isolate:
+            results.append(_run_combo_isolated(
+                salt_name, source, load_from_file, maxiter, verbose))
+        else:
+            results.append(_run_combo(salt_name, source, load_from_file,
+                                      maxiter, verbose))
+    feasible = [r for r in results if _feasible(r)]
     best = min(feasible, key=lambda r: r["cost"]) if feasible else None
     return dict(results=results, best=best)
+
+
+def _feasible(r) -> bool:
+    """Same acceptance the anchor test uses: strict convergence, or a
+    clean trust-region path (every inner Newton solve converged) that
+    stopped on the iteration budget at a feasible point."""
+    if r.get("error"):
+        return False
+    if r["converged"]:
+        return True
+    inner = (r["inner_failures"] if "inner_failures" in r
+             else getattr(r["res"], "inner_failures", 1))
+    return inner == 0

@@ -45,7 +45,9 @@ from dispatches_tpu.case_studies.fossil.storage_charge_design import (
     HOURS_PER_DAY,
     NUM_OF_YEARS,
     OBJ_SCALE,
+    _feasible,
     hx_capital_cost,
+    isolated_json_call,
     salt_pump_cost_per_year,
 )
 from dispatches_tpu.models.salt_hx import SaltSteamHX
@@ -470,18 +472,53 @@ def design_optimize(m: UscModel, heat_duty_mw: float = HEAT_DUTY_FIXED,
     return out
 
 
+def _combo_summary(out) -> Dict:
+    return {
+        "source": out["source"], "cost": float(out["cost"]),
+        "hxd_area": float(out["hxd_area"]),
+        "salt_T_out": float(out["salt_T_out"]),
+        "es_power_mw": float(out["es_power_mw"]),
+        "converged": bool(out["converged"]),
+        "inner_failures": int(out["res"].inner_failures),
+    }
+
+
+def _run_source(source: str, maxiter: int, verbose: int = 0) -> Dict:
+    m = build_discharge_model(source)
+    return design_optimize(m, maxiter=maxiter, verbose=verbose)
+
+
+def _run_source_isolated(source: str, maxiter: int,
+                         verbose: int = 0) -> Dict:
+    """One condensate source in a fresh subprocess (same per-scenario
+    restart/fallback rationale as the charge study's
+    ``_run_combo_isolated``)."""
+    call = (
+        "from dispatches_tpu.case_studies.fossil import "
+        "storage_discharge_design as dd\n"
+        f"out = dd._run_source({source!r}, {maxiter}, verbose={verbose})\n"
+        "print(json.dumps(dd._combo_summary(out)))"
+    )
+    return isolated_json_call(call, {"source": source}, verbose=verbose)
+
+
 def run_design_study(sources: Optional[Tuple[str, ...]] = None,
-                     maxiter: int = 200, verbose: int = 0) -> Dict:
+                     maxiter: int = 200, verbose: int = 0,
+                     isolate: bool = False) -> Dict:
     """Enumerate the condensate sources and pick the minimum-cost design
     — the role of the reference's GDPopt RIC loop (``run_gdp``,
     :1283-1306).  The reference's winner is the condenser-pump source
-    (``test_discharge_usc_powerplant.py:139-140``)."""
+    (``test_discharge_usc_powerplant.py:139-140``).  ``isolate=True``
+    runs each source in a fresh subprocess so one failure cannot take
+    down the enumeration."""
     if sources is None:
         sources = SOURCES
     results = []
     for source in sources:
-        m = build_discharge_model(source)
-        results.append(design_optimize(m, maxiter=maxiter, verbose=verbose))
-    feasible = [r for r in results if r["converged"]]
+        if isolate:
+            results.append(_run_source_isolated(source, maxiter, verbose))
+        else:
+            results.append(_run_source(source, maxiter, verbose))
+    feasible = [r for r in results if _feasible(r)]
     best = min(feasible, key=lambda r: r["cost"]) if feasible else None
     return dict(results=results, best=best)

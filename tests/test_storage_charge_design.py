@@ -82,7 +82,11 @@ def test_solar_hp_design():
                            "NLP compiles exceed the single-core CPU "
                            "suite budget")
 def test_design_study_selects_solar_hp():
-    out = cd.run_design_study(load_from_file=INIT, maxiter=120)
+    # isolate=True: each combo in a fresh subprocess — per-scenario
+    # restart/fallback (one XLA:CPU compiler fault on feature-mismatched
+    # hosts must not kill the enumeration)
+    out = cd.run_design_study(load_from_file=INIT, maxiter=120,
+                              isolate=True)
     best = out["best"]
     assert best is not None
     assert best["salt"] == "solar_salt"

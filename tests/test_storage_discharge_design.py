@@ -61,7 +61,7 @@ def test_condpump_design_anchor():
            "the single-core CPU suite budget",
 )
 def test_design_study_selects_condpump():
-    out = dd.run_design_study(maxiter=120)
+    out = dd.run_design_study(maxiter=120, isolate=True)
     best = out["best"]
     assert best is not None
     assert best["source"] == "condpump"
