@@ -636,7 +636,10 @@ sys.path.insert(0, {repo_root!r})
         print(r.stderr, end="")
     for line in reversed(r.stdout.strip().splitlines()):
         if line.startswith("{"):
-            return json.loads(line)
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue  # verbose child output; keep scanning upward
     return {**identity, "converged": False,
             "error": f"rc={r.returncode}: {r.stderr.strip()[-300:]}"}
 

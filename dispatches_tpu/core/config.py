@@ -215,6 +215,10 @@ def config(cls: Type) -> Type:
         when a file exists there."""
         if isinstance(source, Path):
             text = source.read_text()
+        elif isinstance(source, str) and source.lstrip().startswith(
+            ("{", "[")
+        ):
+            text = source  # structurally JSON, even if a file shadows it
         elif isinstance(source, str) and "\n" not in source and Path(
             source
         ).exists():
@@ -252,7 +256,8 @@ def config(cls: Type) -> Type:
             if meta.get("choices") is not None:
                 kw["choices"] = list(meta["choices"])
             if tp is bool:
-                kw["type"] = lambda s: _coerce("cli", bool, s)
+                # --x / --no-x flag pairs, keeping the validated default
+                kw["action"] = argparse.BooleanOptionalAction
             elif tp is Path:
                 kw["type"] = Path
             else:

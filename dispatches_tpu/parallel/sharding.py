@@ -89,7 +89,13 @@ def scenario_sharded_solver(
             raise ValueError(
                 f"inconsistent scenario-batch sizes: {sorted(sizes)}"
             )
-        n_scen = sizes.pop() if sizes else n_dev
+        if not sizes:
+            raise ValueError(
+                "batched is empty: pass at least one array with a leading "
+                "scenario axis (a misspelled key would otherwise solve "
+                "the defaults once per device)"
+            )
+        n_scen = sizes.pop()
         pad = (-n_scen) % n_dev
 
         p = dict(defaults["p"])

@@ -73,6 +73,25 @@ def test_cli_roundtrip():
     assert d.n == 9 and d.mode == "exact" and d.inner.tol == 0.25
 
 
+def test_cli_bool_flag_pairs():
+    """Bools are --x/--no-x flag pairs (BooleanOptionalAction), matching
+    the store_true convention of the reference's argparse tier."""
+    parser = argparse.ArgumentParser()
+    _Demo.add_cli_args(parser)
+    assert _Demo.from_cli(parser.parse_args(["--no-flag"])).flag is False
+    assert _Demo.from_cli(parser.parse_args(["--flag"])).flag is True
+    assert _Demo.from_cli(parser.parse_args([])).flag is True  # default
+
+
+def test_from_json_string_beats_shadowing_path(tmp_path, monkeypatch):
+    """A str that is structurally JSON is parsed as JSON even when a
+    file of that exact name exists in the cwd."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "{}").write_text('{"n": 3}')  # shadowing file
+    d = _Demo.from_json("{}")  # parsed as empty JSON object, not the file
+    assert d.n == 4  # class default, proving the file was NOT read
+
+
 def test_coercion_bad_int_string_is_config_error():
     with pytest.raises(ConfigError, match="n"):
         _Demo(n="abc")
