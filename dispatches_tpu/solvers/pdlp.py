@@ -41,6 +41,9 @@ class LPResult(NamedTuple):
     pr_err: jnp.ndarray     # relative primal infeasibility (inf-norm)
     du_err: jnp.ndarray     # relative dual infeasibility (inf-norm)
     gap: jnp.ndarray        # relative primal-dual objective gap
+    z: jnp.ndarray = None   # row duals in the ORIGINAL (unequilibrated)
+    #                         constraint space, [eq; ineq] — the shadow
+    #                         prices (e.g. nodal LMPs for a dispatch LP)
 
 
 @dataclass(frozen=True)
@@ -53,6 +56,19 @@ class PDLPOptions:
     dtype: str = "float32"       # f32 is the TPU-native fast path; tests
     #                              on CPU may pick float64 for tight parity
     omega0: float = 1.0          # initial primal weight
+    polish: bool = False         # active-set crossover on the final
+    #                              iterate: identifies the vertex from the
+    #                              f32 PDHG solution and re-solves the
+    #                              active linear system (f32 normal
+    #                              equations, f64 factor + one iterative-
+    #                              refinement step) — lifts the f32 fixed
+    #                              point (~1e-4 objective error) to ~1e-7
+    #                              for ~4% extra FLOPs.  Guarded: the
+    #                              polished point is kept only if its KKT
+    #                              error does not regress.
+    polish_act_tol: float = 1e-3  # relative activity threshold
+    stall_min_iters: int = 2400  # earliest iteration at which the
+    #                              stall ("floored") exit may fire
 
 
 def _ruiz_equilibrate(A, iters):
@@ -191,6 +207,64 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
     def _inf(v):
         return jnp.max(jnp.abs(v)) if v.shape[0] else jnp.asarray(0.0, dtype)
 
+    ridge = jnp.asarray(1e-7)
+
+    def _polish(x, z, c, b):
+        """Active-set crossover (see ``PDLPOptions.polish``).
+
+        The reference certifies LP objectives with a simplex CBC solve
+        (exact vertex); the PDHG fixed point in f32 stops ~1e-4 short.
+        This recovers the vertex: fix variables at their identified
+        active bounds, restrict to the identified active rows, and
+        solve the remaining linear system.  All masking is static-
+        shape (masks, not gathers) so it jits and vmaps.
+        """
+        act = opt.polish_act_tol
+        r = c + ATmv(z)
+        near_lb = jnp.isfinite(lb_h) & (x - lb_h <= act * (1 + jnp.abs(lb_h)))
+        near_ub = jnp.isfinite(ub_h) & (ub_h - x <= act * (1 + jnp.abs(ub_h)))
+        fix_lb = near_lb & (r > 0)
+        fix_ub = near_ub & (r < 0) & ~fix_lb
+        fixed = fix_lb | fix_ub
+        v_fix = jnp.where(fix_lb, lb_h, jnp.where(fix_ub, ub_h, 0.0))
+
+        ax = Amv(x)
+        row_act = is_eq | (jnp.abs(ax - b) <= act * (1 + jnp.abs(b)))
+        rowm = row_act.astype(dtype)
+        freem = (~fixed).astype(dtype)
+
+        # Project x onto the identified face: fix active-bound vars,
+        # then min-norm-correct the free part onto the active rows
+        #   min ||xf - x_free||  s.t.  Mf xf = rhs
+        # (any point of the OPTIMAL face attains the optimal objective,
+        # so face projection — unlike a vertex re-solve — stays exact
+        # under degeneracy, where the identified system is rank-
+        # deficient).  Row-space normal equations on the MXU in
+        # f32-HIGHEST; factor + one iterative-refinement step in f64
+        # (those matvecs are O(mn): cheap even under TPU f64 emulation).
+        M = Ah_raw * rowm[:, None]
+        Mf = M * freem[None, :]
+        x_free = x * freem
+        rhs = b * rowm - jnp.matmul(M, v_fix, precision=_prec)
+        d = rhs - jnp.matmul(Mf, x_free, precision=_prec)
+        H = jnp.matmul(Mf, Mf.T, precision=_prec)
+        f64 = jnp.float64
+        H64 = H.astype(f64) + ridge * jnp.eye(H.shape[0], dtype=f64)
+        from jax.scipy.linalg import cho_solve
+
+        L = jnp.linalg.cholesky(H64)
+        Mf64 = Mf.astype(f64)
+        d64 = d.astype(f64)
+        lam = cho_solve((L, True), d64)
+        resid = d64 - Mf64 @ (Mf64.T @ lam) - ridge * lam
+        lam = lam + cho_solve((L, True), resid)
+        xf = x_free.astype(f64) + Mf64.T @ lam
+        xp64 = jnp.where(fixed, v_fix.astype(f64), xf)
+        xp64 = jnp.clip(xp64, lb_h.astype(f64), ub_h.astype(f64))
+        # guard against a singular/garbage factor (degenerate bases)
+        return jnp.where(jnp.all(jnp.isfinite(xp64)), xp64,
+                         x.astype(f64))
+
     def _pdhg_sweep(x, z, xs, zs, c, b, omega, k):
         """k fixed PDHG steps, extending the running average sums."""
         tau = omega * inv_step
@@ -288,7 +362,16 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             # tol (the f32 floor case); a lane still far away keeps
             # going — PDHG error is non-monotone and plateaus routinely
             # before a restart unlocks progress
-            floored = jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12)
+            # the floored exit may only fire once the lane has done a
+            # real amount of work: lanes that hit 12 stalled checks
+            # EARLY (measured: 1440 iters, e_b 16x tol) are plateaued
+            # before a restart unlocks progress, not f32-floored, and
+            # exiting them there costs ~1.5e-4 objective error — past
+            # the 1e-4 parity budget (BASELINE.md north star)
+            floored = jnp.logical_and(
+                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
+                s["it"] >= opt.stall_min_iters,
+            )
             done = jnp.logical_or(
                 s["done"], jnp.logical_or(e_b < opt.tol, floored)
             )
@@ -331,9 +414,24 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
         xb, zb = out["xb"], out["zb"]
         pr, du, gap = _kkt_errors(xb, zb, c, b)
         x_scaled = xb * dc_j  # back to the CompiledNLP's scaled space
+        if opt.polish:
+            xp64 = _polish(xb, zb, c, b)
+            xp = xp64.astype(dtype)
+            prp, dup, gapp = _kkt_errors(xp, zb, c, b)
+            better = jnp.maximum(jnp.maximum(prp, dup), gapp) <= \
+                jnp.maximum(jnp.maximum(pr, du), gap)
+            pr = jnp.where(better, prp, pr)
+            du = jnp.where(better, dup, du)
+            gap = jnp.where(better, gapp, gap)
+            x_scaled = jnp.where(better, xp, xb) * dc_j
+            # the f64 vertex is what gets certified: route it into the
+            # objective evaluation below through a f64 scaled copy
+            x_obj = jnp.where(better, xp64, xb.astype(jnp.float64)) * dc_j
+        else:
+            x_obj = x_scaled.astype(jnp.result_type(float))
         # evaluate the model objective directly (keeps any constant term
         # that c'x misses, and the user's declared sense)
-        obj = nlp.user_objective(x_scaled.astype(jnp.result_type(float)), params)
+        obj = nlp.user_objective(x_obj, params)
         return LPResult(
             x=x_scaled,
             obj=obj,
@@ -342,6 +440,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             pr_err=pr,
             du_err=du,
             gap=gap,
+            z=zb * dr_j,
         )
 
     return solver

@@ -174,6 +174,12 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
     pdlp.py (one residual eval at x=0 + one objective gradient, vmapped
     over the batch)."""
     opt = options
+    if opt.polish:
+        raise NotImplementedError(
+            "active-set polish is implemented on the per-scenario solver "
+            "(make_pdlp_solver) only; the batch path certifies parity at "
+            "its converged ~1e-5 KKT error without it"
+        )
     dtype = jnp.dtype(opt.dtype)
     data = lp_data if lp_data is not None else make_lp_data(nlp)
     K, G = data["K"], data["G"]
@@ -266,6 +272,11 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
 
         def axis_of(leaf, default_leaf):
             extra = jnp.ndim(leaf) - np.ndim(default_leaf)
+            if extra not in (0, 1):
+                raise ValueError(
+                    f"parameter leaf has {extra} extra leading dims vs the "
+                    "default; expected 0 (broadcast) or 1 (batch axis)"
+                )
             return 0 if extra == 1 else None
 
         axes = jax.tree_util.tree_map(axis_of, batched_params, defaults)
@@ -344,7 +355,14 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             xb = jnp.where(new_best[:, None], xc, s["xb"])
             zb = jnp.where(new_best[:, None], zc, s["zb"])
             stall = jnp.where(improved, 0, s["stall"] + 1)
-            floored = jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12)
+            # same gate as pdlp.py: the floored exit may not fire before
+            # stall_min_iters — an early 12-stall plateau is a pre-
+            # restart lull, not the f32 floor, and exiting there costs
+            # ~1.5e-4 objective error (past the 1e-4 parity budget)
+            floored = jnp.logical_and(
+                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
+                s["it"] >= opt.stall_min_iters,
+            )
             done = jnp.logical_or(s["done"],
                                   jnp.logical_or(e_b < opt.tol, floored))
             it_next = s["it"] + opt.check_every
