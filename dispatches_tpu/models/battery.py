@@ -73,3 +73,17 @@ class BatteryStorage(UnitModel):
 
         self.add_port("power_in", {"electricity": ein})
         self.add_port("power_out", {"electricity": eout})
+
+    def report_columns(self, solution):
+        """The reference battery report's ``kWh`` state column
+        (``dispatches/unit_models/battery.py:196-200``)."""
+        return {
+            "kWh": {
+                "initial_state_of_charge":
+                    self.v("initial_state_of_charge"),
+                "initial_energy_throughput":
+                    self.v("initial_energy_throughput"),
+                "state_of_charge": self.v("state_of_charge"),
+                "energy_throughput": self.v("energy_throughput"),
+            }
+        }

@@ -78,3 +78,14 @@ class SimpleHydrogenTank(UnitModel):
     @property
     def outlet_to_turbine(self):
         return self.turbine_state.port
+
+    def report_columns(self, solution):
+        """Holdup state column alongside the three stream ports
+        (reference ``hydrogen_tank_simplified.py`` material balance
+        vars)."""
+        return {
+            "mol": {
+                "tank_holdup_previous": self.v("tank_holdup_previous"),
+                "tank_holdup": self.v("tank_holdup"),
+            }
+        }

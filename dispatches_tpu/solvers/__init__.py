@@ -1,4 +1,10 @@
-from dispatches_tpu.solvers.ipm import IPMOptions, IPMResult, make_ipm_solver, solve_nlp
+from dispatches_tpu.solvers.ipm import (
+    IPMOptions,
+    IPMResult,
+    format_iteration_trace,
+    make_ipm_solver,
+    solve_nlp,
+)
 from dispatches_tpu.solvers.pdlp_batch import (
     BatchPDLPOptions,
     make_pdlp_batch_solver,

@@ -832,6 +832,36 @@ def make_ipm_solver(
     return solve
 
 
+def format_iteration_trace(trace, result=None, every: int = 1) -> str:
+    """IPOPT-style iteration log from ``make_ipm_solver(..., trace=True)``.
+
+    The operator-facing half of solver observability (SURVEY.md §5): the
+    reference streams this table from IPOPT through idaeslog tee; here
+    the solve is one compiled kernel, so the per-iteration telemetry is
+    recorded on-device by the fixed-length trace scan and rendered
+    after the fact.  Pass the matching ``IPMResult`` to trim the table
+    at the iteration count actually used (finished lanes hold state).
+    """
+    import numpy as np
+
+    mu = np.asarray(trace["mu"])
+    err = np.asarray(trace["kkt_error"])
+    alpha = np.asarray(trace["alpha"])
+    stall = np.asarray(trace["stall"])
+    if mu.ndim > 1:  # vmapped solve: batch axis leads — report lane 0
+        mu, err, alpha, stall = mu[0], err[0], alpha[0], stall[0]
+    if result is not None:
+        it_arr = np.asarray(result.iterations).reshape(-1)
+        n_it = int(it_arr[0])  # lane 0, matching the trace slice
+    else:
+        n_it = len(mu)
+    lines = ["iter         mu    kkt_error      alpha  stall"]
+    for i in range(0, min(n_it, len(mu)), max(every, 1)):
+        lines.append(f"{i:4d}  {mu[i]:9.3e}  {err[i]:11.5e}  "
+                     f"{alpha[i]:9.3e}  {int(stall[i]):5d}")
+    return "\n".join(lines) + "\n"
+
+
 def solve_nlp(nlp, params=None, x0=None, options: Optional[IPMOptions] = None, jit: bool = True):
     """One-shot convenience wrapper: solve a CompiledNLP and return the
     result eagerly (host-side)."""

@@ -1,0 +1,69 @@
+"""Operator-facing observability: per-unit ``report()`` stream tables
+(reference ``dispatches/unit_models/battery.py:178-233``) and the
+solver-iteration trace log (the reference's IPOPT/idaeslog tee output,
+SURVEY.md §5).
+"""
+
+import io
+
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.models import BatteryStorage
+from dispatches_tpu.solvers import (
+    IPMOptions,
+    format_iteration_trace,
+    make_ipm_solver,
+    solve_nlp,
+)
+
+
+@pytest.fixture(scope="module")
+def solved_battery():
+    # the reference battery report example: charge at 5 kW for 1 h
+    fs = Flowsheet(horizon=1)
+    b = BatteryStorage(fs)
+    fs.fix(b.v("nameplate_power"), 5)
+    fs.fix(b.v("nameplate_energy"), 20)
+    fs.fix(b.v("initial_state_of_charge"), 0)
+    fs.fix(b.v("initial_energy_throughput"), 0)
+    fs.fix(b.v("elec_in"), 5)
+    fs.fix(b.v("elec_out"), 0)
+    nlp = fs.compile()
+    res = solve_nlp(nlp)
+    assert bool(res.converged)
+    return fs, b, nlp, nlp.unravel(res.x)
+
+
+def test_battery_report_stream_table(solved_battery):
+    _, b, _, sol = solved_battery
+    buf = io.StringIO()
+    text = b.report(sol, ostream=buf)
+    assert text == buf.getvalue()
+    # banner + port columns + the reference's kWh state column
+    assert "Unit : battery" in text and "Time: 0" in text
+    assert "power_in" in text and "power_out" in text and "kWh" in text
+    for row in ("electricity", "initial_state_of_charge",
+                "state_of_charge", "energy_throughput"):
+        assert row in text
+    # the solved numbers (charge 5 kW * 0.95 -> soc 4.75, thru 2.5)
+    assert "4.75" in text and "2.5" in text
+
+
+def test_report_dof_stats(solved_battery):
+    _, b, _, sol = solved_battery
+    text = b.report(sol, dof=True, ostream=io.StringIO())
+    assert "Local Variable Elements:" in text
+    assert "Local Constraints Declared:" in text
+
+
+def test_iteration_trace_log(solved_battery):
+    fs, _, nlp, _ = solved_battery
+    solver = make_ipm_solver(nlp, IPMOptions(max_iter=40), trace=True)
+    res, trace = solver(nlp.default_params())
+    log = format_iteration_trace(trace, result=res)
+    lines = log.strip().splitlines()
+    assert lines[0].split() == ["iter", "mu", "kkt_error", "alpha",
+                                "stall"]
+    # one row per iteration actually taken
+    assert len(lines) - 1 == int(res.iterations)
