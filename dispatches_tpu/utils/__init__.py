@@ -13,7 +13,12 @@ from dispatches_tpu.utils.cashflow import (
     macrs_amortization,
     build_cashflows,
 )
-from dispatches_tpu.utils.synhist import ARMAModel, generate_syn_realizations
+from dispatches_tpu.utils.synhist import (
+    ARMAModel,
+    RavenARMAROM,
+    generate_clustered_realizations,
+    generate_syn_realizations,
+)
 
 __all__ = [
     "CashFlowSettings",
@@ -25,5 +30,7 @@ __all__ = [
     "macrs_amortization",
     "build_cashflows",
     "ARMAModel",
+    "RavenARMAROM",
+    "generate_clustered_realizations",
     "generate_syn_realizations",
 ]

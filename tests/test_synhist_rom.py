@@ -1,0 +1,112 @@
+"""RAVEN ARMA ROM artifact port (utils/synhist.RavenARMAROM).
+
+The reference ships the ROM as a RAVEN training spec + data
+(``case_studies/nuclear_case/ARMA_Model/``: ``ARMA_train.xml``,
+``Price_20xx.csv``, year-pointer CSV) consumed through
+``dispatches/util/syn_hist_integration.py``.  These tests train our
+port from that exact artifact and assert (a) the consumption-path dict
+shape the reference builds (``syn_hist_integration.py:100-126``), and
+(b) statistical parity of the sampled histories against the training
+data (mean / spread / diurnal autocorrelation / CDF), which is the
+strongest parity available without running RAVEN itself.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.utils import (
+    RavenARMAROM,
+    generate_clustered_realizations,
+)
+
+ARTIFACT = Path(
+    "/root/reference/dispatches/case_studies/nuclear_case/ARMA_Model")
+
+pytestmark = pytest.mark.skipif(
+    not ARTIFACT.exists(), reason="reference ARMA artifact not mounted")
+
+
+@pytest.fixture(scope="module")
+def rom():
+    return RavenARMAROM.train_from_artifact(ARTIFACT)
+
+
+@pytest.fixture(scope="module")
+def training_prices():
+    return {
+        y: np.loadtxt(ARTIFACT / f"Price_{y}.csv", delimiter=",",
+                      skiprows=1, usecols=1)
+        for y in (2018, 2019, 2020, 2021)
+    }
+
+
+def test_spec_parsed_from_artifact(rom):
+    # values come from ARMA_train.xml, not hard-coded here
+    assert rom.n_clusters == 20
+    assert rom.pivot_length == 24
+    assert rom.periods[0] == 8760.0 and rom.periods[-1] == 12.0
+    # pointer interpolates 2018-2021 through a 2045 anchor
+    assert sorted(rom.years) == [2018, 2019, 2020, 2021, 2045]
+    # the 2045 anchor points at Price_2021.csv -> identical parameters
+    np.testing.assert_array_equal(rom.fourier_coef[2045],
+                                  rom.fourier_coef[2021])
+
+
+def test_synthetic_history_dict_shape(rom):
+    """Exact consumption-path structure of syn_hist_integration.py:
+    weights_days / cluster_map / LMP keyed 1..20 clusters, 1..24 h."""
+    hist = rom.generateSyntheticHistory("price", [2018, 2020])
+    for year in (2018, 2020):
+        assert set(hist["LMP"][year]) == set(range(1, 21))
+        assert set(hist["LMP"][year][1]) == set(range(1, 25))
+        # weights are the cluster sizes and partition the 365 days
+        assert sum(hist["weights_days"][year].values()) == 365
+        all_days = sorted(
+            d for days in hist["cluster_map"][year].values() for d in days)
+        assert all_days == list(range(365))
+    with pytest.raises(KeyError):
+        rom.generateSyntheticHistory("bogus", [2018])
+
+
+def test_macro_year_interpolation(rom):
+    """Segment grouping='interpolate': untrained years inside the span
+    sample from linearly interpolated parameters; outside raises."""
+    hist = rom.generateSyntheticHistory("price", [2030])
+    assert set(hist["LMP"][2030]) == set(range(1, 21))
+    with pytest.raises(ValueError):
+        rom.generateSyntheticHistory("price", [2050])
+
+
+def test_statistical_parity_vs_training_data(rom, training_prices):
+    """Weight-expanded sampled year vs its training year: annual mean,
+    spread, diurnal (lag-24) autocorrelation, and CDF quantiles."""
+    for year in (2018, 2021):
+        ref = training_prices[year]
+        lmp = np.asarray(
+            generate_clustered_realizations(rom, [year], seed=7)[year])
+        assert lmp.shape == (365 * 24,)
+        # annual mean within 5% of training data (preserveInputCDF
+        # pins the marginal distribution, so this is tight)
+        assert abs(lmp.mean() - ref.mean()) / ref.mean() < 0.05
+        assert abs(lmp.std() - ref.std()) / ref.std() < 0.15
+        # CDF parity: deciles of the sampled signal track training
+        q = np.linspace(0.1, 0.9, 9)
+        np.testing.assert_allclose(
+            np.quantile(lmp, q), np.quantile(ref, q),
+            rtol=0.2, atol=2.0)
+
+        def acf24(x):
+            x = x - x.mean()
+            return float(np.mean(x[24:] * x[:-24]) / np.mean(x * x))
+
+        # diurnal structure present and of the right sign/magnitude
+        assert abs(acf24(lmp) - acf24(ref)) < 0.3
+
+
+def test_reseed_gives_distinct_scenarios(rom):
+    two = generate_clustered_realizations(rom, [2019], n_scenarios=2)
+    a = np.asarray(two[1][2019])
+    b = np.asarray(two[2][2019])
+    assert a.shape == b.shape and not np.allclose(a, b)
