@@ -259,15 +259,19 @@ def make_structured_kkt(ts: TimeStructure, n_y: int, m: int):
                 S[k * nps + i, np.asarray(ts.period_y_idx)[tsel, i]] = 1.0
         for jb in range(n_by):
             S[3 * nps + jb, ts.border_y_idx[jb]] = 1.0
-        return jnp.asarray(S, dtype)
+        return S.astype(dtype)
 
     _seeds_cache = {}
 
     def seeds_for(dtype):
+        # cache HOST arrays only: caching the jnp constant would pin a
+        # tracer from whichever jit trace ran first, leaking it into
+        # every later trace of this solver (observed: a sequential
+        # bidder solve followed by the vmapped day-batch solve)
         key = jnp.dtype(dtype).name
         if key not in _seeds_cache:
-            _seeds_cache[key] = _seed_matrix(dtype)
-        return _seeds_cache[key]
+            _seeds_cache[key] = _seed_matrix(np.dtype(key))
+        return jnp.asarray(_seeds_cache[key])
 
     # gather maps for block extraction -------------------------------
     # response R has shape (3*nps + n_by, n_rows); blocks:

@@ -170,3 +170,31 @@ def test_structured_vs_dense_kkt_odd_horizons(T):
     )
     np.testing.assert_allclose(np.asarray(dy), sol[:n_y], rtol=1e-7, atol=1e-8)
     np.testing.assert_allclose(np.asarray(dlam), sol[n_y:], rtol=1e-7, atol=1e-8)
+
+
+def test_structured_solver_retrace_after_sequential(monkeypatch):
+    """Regression: the seed-matrix cache must hold HOST arrays.  Caching
+    the jnp constant pinned a tracer from the first jit trace, and any
+    LATER trace of the same solver (e.g. the day-parallel bidder's
+    vmapped batch after one sequential solve) died with
+    UnexpectedTracerError."""
+    import jax
+
+    from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+    nlp = _model(T=24)
+    solver = make_ipm_solver(nlp, IPMOptions(kkt="structured",
+                                             max_iter=60))
+    params = nlp.default_params()
+    r1 = jax.jit(solver)(params)          # first trace caches seeds
+    # second, different trace of the same closure: vmap over a batch
+    axes = ({"p": {k: (0 if k == "price" else None) for k in params["p"]},
+             "fixed": None},)
+    batched = {
+        "p": {**params["p"],
+              "price": np.stack([params["p"]["price"]] * 3)},
+        "fixed": params["fixed"],
+    }
+    rb = jax.jit(jax.vmap(solver, in_axes=axes))(batched)
+    np.testing.assert_allclose(np.asarray(rb.obj),
+                               float(r1.obj) * np.ones(3), rtol=1e-6)
