@@ -112,6 +112,22 @@ class MultiPeriodWindBattery:
 
         blk.power_output_values = power_output_values
 
+    def batch_day_params(self, blk, n_days: int):
+        """Deterministic per-day param windows for day-parallel bidding
+        (SURVEY §2.7): day ``i`` of the batch sees the capacity-factor
+        window ``update_model`` would have rolled to after ``i``
+        implemented days.  Realized initial conditions are NOT advanced
+        here — they are outcome-dependent and re-sync sequentially
+        through ``update_model`` at each window boundary."""
+        rows = []
+        for i in range(n_days):
+            idx = blk._time_idx + 24 * i
+            cfs = self._wind_capacity_factors[idx: idx + blk.horizon]
+            if len(cfs) < blk.horizon:
+                cfs = np.pad(cfs, (0, blk.horizon - len(cfs)), mode="edge")
+            rows.append(np.asarray(cfs, float))
+        return {"windpower.capacity_factor": np.stack(rows)}
+
     def update_model(self, blk, realized_soc, realized_energy_throughput):
         """Advance realized initial conditions + CF window
         (reference :182-210)."""

@@ -128,32 +128,52 @@ class _BidderBase:
             np.asarray(self._forecast(d, 0, H)) for d in dates
         ])  # (D, n_scenario, H)
         params = blk.stacked.default_params()
+        # deterministic per-day param windows (e.g. the rolling CF
+        # window update_model would shift to) so batched day-i bids
+        # equal the sequential loop's given window-start state
+        mo = self.bidding_model_object
+        overrides = (mo.batch_day_params(blk, len(dates))
+                     if hasattr(mo, "batch_day_params") else {})
+        # map each override onto the matching param key: exact name, or
+        # a dotted-qualified form of it (never a bare suffix — that
+        # would capture sibling units like 'offshore_windpower.…')
+        ov_keys = {
+            k: ov
+            for k in params["p"]
+            for name, ov in overrides.items()
+            if k == name or k.endswith("." + name)
+        }
         # the compiled D-wide batch solver is cached on the model block:
         # jit caches by function identity, so rebuilding vmap(...) per
         # rolling window would recompile the whole IPM batch every call
         cache = getattr(blk, "_batch_solvers", None)
         if cache is None:
             cache = blk._batch_solvers = {}
-        vsolve = cache.get(len(dates))
+        ck = (len(dates), tuple(sorted(ov_keys)))
+        vsolve = cache.get(ck)
         if vsolve is None:
-            in_axes = ({"p": {k: (0 if k == "energy_price" else None)
+            in_axes = ({"p": {k: (0 if k == "energy_price" or k in ov_keys
+                                  else None)
                               for k in params["p"]},
                         "fixed": None},)
             vsolve = jax.jit(jax.vmap(blk.solver_fn, in_axes=in_axes))
-            cache[len(dates)] = vsolve
+            cache[ck] = vsolve
         arr = jnp.asarray(prices_days)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             arr = jax.device_put(arr, NamedSharding(mesh, P(mesh.axis_names[0])))
-        batched = {"p": {**params["p"], "energy_price": arr},
+        batched = {"p": {**params["p"], "energy_price": arr,
+                         **{k: jnp.asarray(ov) for k, ov in ov_keys.items()}},
                    "fixed": params["fixed"]}
         res = vsolve(batched)
         xs = np.asarray(res.x)
         out = {}
         for i, d in enumerate(dates):
             day_params = {"p": {**params["p"],
-                                "energy_price": jnp.asarray(prices_days[i])},
+                                "energy_price": jnp.asarray(prices_days[i]),
+                                **{k: jnp.asarray(ov[i])
+                                   for k, ov in ov_keys.items()}},
                           "fixed": params["fixed"]}
             powers = blk.stacked.scenario_profiles(xs[i], day_params)
             out[d] = self._format_bids(blk, prices_days[i], powers, xs[i], H)

@@ -79,8 +79,36 @@ class DoubleLoopCoordinator:
 
     # -- market-cycle hooks -------------------------------------------
 
+    def prefetch_da_bids(self, dates, mesh=None) -> None:
+        """Day-parallel DA bidding (SURVEY §2.7): solve the bid programs
+        for a whole window of ``dates`` as one device batch (optionally
+        sharded over ``mesh``), to be consumed by ``request_da_bids``
+        day by day.  Realized state still re-syncs sequentially through
+        ``push_rt_dispatch``/``update_*_model`` at window boundaries."""
+        fc = self.bidder.forecaster
+        if hasattr(fc, "record_day_ahead_price") or hasattr(
+            fc, "fetch_hourly_stats_from_prescient"
+        ):
+            import warnings
+
+            warnings.warn(
+                "day-parallel DA bidding with a history-recording "
+                "forecaster: days after the first use window-start "
+                "price history, so bids can differ from the sequential "
+                "loop (state-neutral preconditions in "
+                "MarketSimulator.simulate's docstring)",
+                stacklevel=2,
+            )
+        batch = self.bidder.compute_day_ahead_bids_batch(list(dates),
+                                                         mesh=mesh)
+        self._da_prefetch = dict(batch)
+
     def request_da_bids(self, date):
-        bids = self.bidder.compute_day_ahead_bids(date=date)
+        pre = getattr(self, "_da_prefetch", None)
+        if pre and date in pre:
+            bids = pre.pop(date)
+        else:
+            bids = self.bidder.compute_day_ahead_bids(date=date)
         self.bidder.record_bids(bids, date, 0, market="Day-ahead")
         return bids
 
@@ -121,11 +149,17 @@ class DoubleLoopCoordinator:
                 price = next(iter(bus_lmps.values()))
             fc.fetch_hourly_stats_from_prescient({bus: float(price)})
         # advance the bidder's operating models with the implemented
-        # profile every 24 implemented hours
+        # profiles every 24 implemented hours.  The whole day's hourly
+        # profiles are concatenated: update_model advances the CF
+        # window by the realized profile LENGTH, so passing only the
+        # last tracked hour would roll the window 1 h/day instead of
+        # 24 (a drift the day-parallel parity test caught — the batched
+        # path's per-day windows exposed the sequential lag)
         self._hour_in_day += 1
         if self._hour_in_day >= 24 and self.tracker.implemented_stats:
             self._hour_in_day = 0
-            profile = self.tracker.implemented_stats[-1]
+            day = self.tracker.implemented_stats[-24:]
+            profile = {k: [x for pr in day for x in pr[k]] for k in day[0]}
             self.bidder.update_day_ahead_model(**profile)
             self.bidder.update_real_time_model(**profile)
         return self.tracker.get_last_delivered_power()

@@ -801,7 +801,20 @@ class MarketSimulator:
                     )
                     t.noload_cost = float(pts[0, 1])
 
-    def simulate(self, start_date: str, num_days: int):
+    def simulate(self, start_date: str, num_days: int,
+                 da_bid_window: int = 1, mesh=None):
+        """Run the two-settlement co-simulation.
+
+        ``da_bid_window > 1`` turns on day-parallel DA bidding (SURVEY
+        §2.7): at each window boundary the participant's bid programs
+        for the next ``da_bid_window`` days are solved as ONE batched
+        device program (optionally sharded over ``mesh``), while
+        tracking/settlement and realized-state re-sync stay sequential.
+        Day-parallel bids match the sequential loop's exactly whenever
+        the within-window feedback is state-neutral (static forecaster
+        pools and day-boundary-neutral realized state) — asserted by
+        ``tests/test_market.py``.
+        """
         case = self.case
         start = pd.Timestamp(start_date)
         hour0 = int((start - case.start_timestamp).total_seconds() // 3600)
@@ -838,6 +851,13 @@ class MarketSimulator:
 
             da_bids = None
             if self.coordinator is not None:
+                if da_bid_window > 1 and day % da_bid_window == 0:
+                    window = [
+                        (start + pd.Timedelta(days=day + k)).strftime(
+                            "%Y-%m-%d")
+                        for k in range(min(da_bid_window, num_days - day))
+                    ]
+                    self.coordinator.prefetch_da_bids(window, mesh=mesh)
                 da_bids = self.coordinator.request_da_bids(date)
 
             u = solve_unit_commitment(
