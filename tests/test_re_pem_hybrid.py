@@ -179,6 +179,36 @@ def test_wind_battery_pem_parity_6x24():
     assert out.npv == pytest.approx(2_322_131_921, rel=3e-2)
 
 
+@pytest.mark.skipif(not _HAS_DATA, reason="reference data not mounted")
+def test_pem_parity_6x24_at_reference_design():
+    """Matched-design decomposition of the 6x24 residual (round-5 study,
+    ``models/wind_power.py`` module note): with the battery pinned at
+    the reference's reported optimum (4,874 MW) and PEM at zero, the
+    revenue stream matches the reference's own ``annual_rev_E`` anchor
+    WITHIN its own tolerance (rel 1e-2; measured 3.6e-3), and the NPV
+    residual is the capex-leverage amplification of that +0.36% revenue
+    bias (PA*rev/NPV ~ 3.5 -> 1.3e-2)."""
+    prices = lp.load_rts_test_prices()
+    ws = lp.load_wind_speeds()
+    params = _params(
+        wind_mw=lp.fixed_wind_mw,
+        wind_mw_ub=lp.wind_mw_ub,
+        batt_mw=4874.0,
+        pem_mw=0.0,
+        capacity_factors=None,
+        wind_speeds=ws,
+        DA_LMPs=prices,
+        h2_price_per_kg=2.5,
+        design_opt=False,
+    )
+    out = wind_battery_pem_optimize(6 * 24, params, verbose=False)
+    assert out.res.converged
+    # the reference's own annual_rev_E assert and tolerance (:136)
+    assert out.annual_revenue == pytest.approx(531_576_401, rel=1e-2)
+    # NPV at matched design: leverage-amplified revenue bias only
+    assert out.npv == pytest.approx(2_322_131_921, rel=1.5e-2)
+
+
 @pytest.mark.skipif(
     not (_HAS_DATA and __import__("os").environ.get("DISPATCHES_TPU_SLOW")),
     reason="6x24 full-hybrid NLP parity is a several-minute solve "
