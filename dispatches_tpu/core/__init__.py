@@ -1,3 +1,4 @@
+"""Modeling core: flowsheet graph, NLP lowering, typed config."""
 from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec, Port
 from dispatches_tpu.core.compile import CompiledNLP
 from dispatches_tpu.core.config import ConfigError, config, config_field

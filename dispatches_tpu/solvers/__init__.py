@@ -1,3 +1,4 @@
+"""Batched on-device solvers: IPM, PDLP (+batch/Pallas), Newton, reduced-space."""
 from dispatches_tpu.solvers.ipm import (
     IPMOptions,
     IPMResult,
