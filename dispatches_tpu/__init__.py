@@ -32,13 +32,39 @@ if not os.environ.get("DISPATCHES_TPU_NO_COMPILE_CACHE"):
     # Persistent XLA compilation cache: flowsheet solve kernels (IPM over
     # a few-hundred-variable NLP) take minutes to compile on a small host
     # but are identical across processes/test runs — cache them on disk.
+    #
+    # The directory is keyed by the host's CPU feature set: XLA:CPU AOT
+    # results compiled under one feature set load with "could lead to
+    # SIGILL" warnings on another and have produced real segfaults in
+    # large fresh compiles (the design-study crashes round 4 had to
+    # subprocess-isolate).  A host change now starts a fresh cache
+    # instead of replaying incompatible AOT blobs.
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("DISPATCHES_TPU_COMPILE_CACHE",
-                       os.path.expanduser("~/.cache/dispatches_tpu_xla")),
-    )
+    def _host_cpu_tag() -> str:
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        import hashlib
+
+                        return hashlib.sha1(
+                            line.encode()).hexdigest()[:10]
+        except OSError:
+            pass
+        import platform
+
+        return platform.machine() or "unknown"
+
+    _explicit = os.environ.get("DISPATCHES_TPU_COMPILE_CACHE")
+    if _explicit:
+        # an explicitly pinned cache path is honored verbatim (e.g. a
+        # CI-prewarmed mount); only the shared default gets the suffix
+        _cache_dir = _explicit
+    else:
+        _cache_dir = (os.path.expanduser("~/.cache/dispatches_tpu_xla")
+                      + "-" + _host_cpu_tag())
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 from dispatches_tpu.core.graph import Flowsheet, UnitModel, VarSpec  # noqa: E402
