@@ -4,6 +4,7 @@ tiny real dataset, 2 simulated days, non-empty outputs) plus LMP
 sanity checks against the marginal unit's cost, and the full
 double-loop cycle with a wind+battery participant in the loop."""
 
+import os
 from pathlib import Path
 
 import numpy as np
@@ -264,6 +265,12 @@ def _build_wind_battery_cosim(case, out_dir, cfs, hist):
     )
 
 
+@pytest.mark.skipif(
+    not os.environ.get("DISPATCHES_TPU_SLOW"),
+    reason="two full 2-day co-simulations (~5 min single-core); the "
+    "day-parallel parity is slow-lane coverage (fast-lane trim, "
+    "round 5) — set DISPATCHES_TPU_SLOW=1 to run",
+)
 def test_day_parallel_double_loop_matches_sequential(tmp_path, case):
     """SURVEY §2.7 day-parallel rolling horizon: DA bidding for the
     whole window solved as ONE batched device program
