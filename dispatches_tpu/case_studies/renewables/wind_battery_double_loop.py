@@ -119,14 +119,21 @@ class MultiPeriodWindBattery:
         implemented days.  Realized initial conditions are NOT advanced
         here — they are outcome-dependent and re-sync sequentially
         through ``update_model`` at each window boundary."""
-        rows = []
-        for i in range(n_days):
-            idx = blk._time_idx + 24 * i
-            cfs = self._wind_capacity_factors[idx: idx + blk.horizon]
-            if len(cfs) < blk.horizon:
-                cfs = np.pad(cfs, (0, blk.horizon - len(cfs)), mode="edge")
-            rows.append(np.asarray(cfs, float))
+        rows = [self._cf_window(blk._time_idx + 24 * i, blk.horizon)
+                for i in range(n_days)]
         return {"windpower.capacity_factor": np.stack(rows)}
+
+    def _cf_window(self, start: int, horizon: int) -> np.ndarray:
+        """CF window [start, start+horizon), edge-extended past the data
+        end (a clamped start keeps the slice non-empty, so rolling
+        fully past the series continues its last value).  Shared by the
+        sequential roll and the day-batch so the two paths cannot
+        drift."""
+        start = min(int(start), len(self._wind_capacity_factors) - 1)
+        cfs = self._wind_capacity_factors[start: start + horizon]
+        if len(cfs) < horizon:
+            cfs = np.pad(cfs, (0, horizon - len(cfs)), mode="edge")
+        return np.asarray(cfs, float)
 
     def update_model(self, blk, realized_soc, realized_energy_throughput):
         """Advance realized initial conditions + CF window
@@ -140,12 +147,8 @@ class MultiPeriodWindBattery:
         ].fixed_value = np.asarray(round(float(realized_energy_throughput[-1]), 2))
 
         blk._time_idx += min(len(realized_soc), 24)
-        cfs = self._wind_capacity_factors[
-            blk._time_idx: blk._time_idx + blk.horizon
-        ]
-        if len(cfs) < blk.horizon:
-            cfs = np.pad(cfs, (0, blk.horizon - len(cfs)), mode="edge")
-        fs.params["windpower.capacity_factor"] = np.asarray(cfs)
+        fs.params["windpower.capacity_factor"] = self._cf_window(
+            blk._time_idx, blk.horizon)
 
     @staticmethod
     def get_last_delivered_power(blk, sol, last_implemented_time_step: int):

@@ -124,7 +124,10 @@ def test_day_parallel_bids_match_sequential():
 
     rng = np.random.default_rng(3)
     horizon = 8
-    cfs = 0.3 + 0.4 * rng.random(horizon * 2)
+    # 28 h of data with 24-h day strides: day 0 fully in-range, day 1 a
+    # PARTIAL window (edge-pad branch), days 2-3 fully past the end
+    # (clamped-start branch) — all three _cf_window regimes covered
+    cfs = 0.3 + 0.4 * rng.random(horizon * 2 + 12)
     md = RenewableGeneratorModelData(
         gen_name="4_WIND", bus="4", p_min=0.0, p_max=120.0
     )
@@ -155,9 +158,22 @@ def test_day_parallel_bids_match_sequential():
         max_iter=120,
     )
 
-    seq = {d: bidder.compute_day_ahead_bids(d) for d in dates}
+    # batch first (window-start state), then the sequential loop WITH
+    # the co-sim's day-boundary re-sync (state-neutral realized
+    # profiles advance the CF window 24 h/day, round 5): the batch
+    # path's per-day windows (batch_day_params) must reproduce exactly
+    # what the re-syncing sequential loop sees
     mesh = scenario_mesh(4, axis="day")
     par = bidder.compute_day_ahead_bids_batch(dates, mesh=mesh)
+
+    seq = {}
+    for i, d in enumerate(dates):
+        if i:
+            bidder.update_day_ahead_model(
+                realized_soc=[0.0] * 24,
+                realized_energy_throughput=[0.0] * 24,
+            )
+        seq[d] = bidder.compute_day_ahead_bids(d)
 
     assert set(par) == set(dates)
     for d in dates:
