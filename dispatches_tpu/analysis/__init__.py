@@ -1,0 +1,43 @@
+"""Static analysis + runtime sanitizers for JAX discipline.
+
+Two halves share this package:
+
+* ``graftlint`` — an AST pass (rules GL001-GL006) catching the patterns
+  that silently destroy the port's lower-once property: host calls on
+  tracers, Python branches on traced values, bad static_argnums, jnp
+  construction in per-hour host loops, unguarded float64 casts, and
+  unregistered ``DISPATCHES_TPU_*`` flags.  Run it with
+  ``python -m dispatches_tpu.analysis --check``.
+* ``runtime`` — ``graft_jit`` (jax.jit with recompile accounting +
+  ``assert_no_recompiles()`` for steady-state tests) and ``nan_guard``
+  (opt-in NaN/Inf checks behind ``DISPATCHES_TPU_SANITIZE``).
+"""
+
+from dispatches_tpu.analysis.flags import (  # noqa: F401
+    REGISTERED_FLAGS,
+    flag_enabled,
+    flag_name,
+)
+from dispatches_tpu.analysis.graftlint import (  # noqa: F401
+    DEFAULT_BASELINE,
+    RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from dispatches_tpu.analysis.runtime import (  # noqa: F401
+    RecompileWarning,
+    SanitizeWarning,
+    assert_no_recompiles,
+    checkified,
+    drain_sanitize_events,
+    graft_jit,
+    nan_guard,
+    recompile_counts,
+    reset_recompile_counts,
+    sanitize_enabled,
+)
+from dispatches_tpu.analysis.selftest import CORPUS, run_selftest  # noqa: F401

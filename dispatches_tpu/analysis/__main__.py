@@ -1,0 +1,80 @@
+"""CLI: ``python -m dispatches_tpu.analysis [--check|--write-baseline|
+--selftest] [paths...]``.
+
+Default action is ``--check`` over the installed ``dispatches_tpu``
+package: lint, subtract the committed baseline, and exit non-zero iff
+NEW findings exist.  CI (tests/test_analysis.py) runs exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from dispatches_tpu.analysis.graftlint import (
+    DEFAULT_BASELINE,
+    lint_paths,
+    load_baseline,
+    new_findings,
+    package_root,
+    write_baseline,
+)
+from dispatches_tpu.analysis.selftest import run_selftest
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dispatches_tpu.analysis",
+        description="graftlint: JAX-discipline static analysis",
+    )
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to lint (default: the "
+                         "dispatches_tpu package)")
+    ap.add_argument("--check", action="store_true",
+                    help="fail on findings beyond the baseline "
+                         "(default action)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings as legacy")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the rule self-test corpus")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    ns = ap.parse_args(argv)
+
+    if ns.selftest:
+        errors = run_selftest()
+        for e in errors:
+            print(f"SELFTEST FAIL: {e}")
+        if not errors:
+            print("graftlint selftest: all rules fire / no false "
+                  "positives on the corpus")
+        return 1 if errors else 0
+
+    paths = ns.paths or [package_root()]
+    findings = lint_paths(paths)
+
+    if ns.write_baseline:
+        n = write_baseline(findings, ns.baseline)
+        print(f"graftlint: wrote {n} baseline finding(s) to {ns.baseline}")
+        return 0
+
+    baseline = load_baseline(ns.baseline)
+    fresh = new_findings(findings, baseline)
+    for f in fresh:
+        print(f"{f.render()}  [fingerprint {f.fingerprint}]")
+    n_base = len(findings) - len(fresh)
+    print(
+        f"graftlint: {len(findings)} finding(s), {n_base} baselined, "
+        f"{len(fresh)} new"
+    )
+    if fresh:
+        print(
+            "New findings fail --check. Fix them, or (for accepted "
+            "legacy debt) regenerate the baseline with --write-baseline."
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Registry of every ``DISPATCHES_TPU_*`` environment flag the package
+reads.
+
+graftlint rule GL006 cross-checks every ``os.environ`` /``os.getenv``
+access of a ``DISPATCHES_TPU_*`` name in the package against this table,
+so a flag cannot be introduced ad hoc (undocumented, untestable, and
+invisible to operators) — add the flag here, with a one-line meaning,
+in the same change that reads it.
+
+This module must stay import-light (stdlib only): the linter imports it
+to learn the registry and the runtime sanitizers import it to resolve
+flag state.
+"""
+
+from __future__ import annotations
+
+import os
+
+# name (without the DISPATCHES_TPU_ prefix) -> what setting it does
+REGISTERED_FLAGS = {
+    "NO_X64": "disable the default float64 mode (package __init__)",
+    "NO_COMPILE_CACHE": "disable the persistent XLA compile cache",
+    "COMPILE_CACHE": "override the persistent compile-cache directory",
+    "DATA": "override the vendored reference-data directory",
+    "RTS_GMLC": "override the RTS-GMLC source-data directory",
+    "SLOW": "enable the slow co-simulation test lane",
+    "EXTENDED": "enable extended sweep tests",
+    "SANITIZE": "enable runtime NaN/Inf guards on solver iterates "
+    "(analysis.runtime.nan_guard; read at trace time)",
+    "WARN_RECOMPILE": "warn whenever a graft_jit-wrapped callable "
+    "retraces after its first compile",
+}
+
+_PREFIX = "DISPATCHES_TPU_"
+
+
+def flag_name(short: str) -> str:
+    """Full environment-variable name for a registered flag."""
+    if short not in REGISTERED_FLAGS:
+        raise KeyError(
+            f"{_PREFIX}{short} is not registered in "
+            "dispatches_tpu.analysis.flags.REGISTERED_FLAGS"
+        )
+    return _PREFIX + short
+
+
+def flag_enabled(short: str) -> bool:
+    """Truthiness of a registered boolean flag ('' and '0' are off)."""
+    val = os.environ.get(flag_name(short), "")
+    return val not in ("", "0", "false", "False")

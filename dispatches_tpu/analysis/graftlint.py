@@ -1,0 +1,641 @@
+"""graftlint: an AST pass enforcing the JAX discipline the port's
+performance rests on.
+
+Every rule encodes a way this codebase can silently lose the "lower
+once, reuse the kernel" property (PAPER.md §0) or its dtype contract:
+
+GL001 host-call-in-traced   float()/int()/np.asarray()/.item() on a
+                            value inside a traced function — host
+                            materialization of a tracer (TracerError at
+                            best, silent per-call constant-folding at
+                            worst).
+GL002 tracer-branch         Python ``if``/``while`` on a value derived
+                            from a traced function's arguments —
+                            branch decisions burn into the trace and
+                            force retraces (or TracerBoolConversion).
+GL003 bad-static-argnums    ``static_argnums``/``static_argnames`` that
+                            are not literal ints/strings — non-hashable
+                            or array-valued statics either crash or
+                            retrace per call.
+GL004 hot-loop-array        ``jnp`` array construction inside a
+                            per-hour/per-day host loop — device
+                            round-trips in exactly the loops the port
+                            exists to keep off the host.
+GL005 bare-astype-f64       ``astype(float64)`` in a module that never
+                            consults ``jax.config.jax_enable_x64`` —
+                            under NO_X64 the cast silently degrades to
+                            f32 (the round-5 ``_polish`` finding).
+GL006 unregistered-env-flag ``DISPATCHES_TPU_*`` environment reads not
+                            registered in ``analysis.flags`` —
+                            undocumented knobs.
+
+Findings are reported as ``file:line rule-id message`` and fingerprinted
+by (relpath, rule, normalized source line) — line-number independent, so
+the committed baseline (``graftlint.baseline``) survives unrelated
+edits.  ``--check`` fails only on findings NOT in the baseline.
+
+This module is stdlib-only (ast/hashlib/pathlib) so the linter can run
+without initializing JAX; the flag registry it cross-checks lives in the
+equally import-light ``analysis.flags``.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from dispatches_tpu.analysis.flags import _PREFIX, REGISTERED_FLAGS
+
+RULES: Dict[str, str] = {
+    "GL001": "host-call-in-traced",
+    "GL002": "tracer-branch",
+    "GL003": "bad-static-argnums",
+    "GL004": "hot-loop-array",
+    "GL005": "bare-astype-f64",
+    "GL006": "unregistered-env-flag",
+}
+
+DEFAULT_BASELINE = Path(__file__).with_name("graftlint.baseline")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # posix relpath used in fingerprints
+    line: int
+    col: int
+    rule: str
+    message: str
+    source: str  # stripped source line
+
+    @property
+    def fingerprint(self) -> str:
+        key = f"{self.path}|{self.rule}|{self.source}"
+        return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"[{RULES[self.rule]}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+# names that trace their function-valued arguments (positional slots)
+_TRANSFORM_ARG_SLOTS = {
+    "jit": (0,), "vmap": (0,), "pmap": (0,), "grad": (0,),
+    "value_and_grad": (0,), "jacfwd": (0,), "jacrev": (0,),
+    "hessian": (0,), "checkify": (0,), "shard_map": (0,),
+    "pallas_call": (0,), "custom_jvp": (0,), "custom_vjp": (0,),
+    "scan": (0,), "associative_scan": (0,), "map": (0,),
+    "while_loop": (0, 1), "fori_loop": (2,), "cond": (1, 2),
+    "switch": (1, 2, 3, 4),
+}
+_TRANSFORM_FUNC_KWARGS = {
+    "f", "fun", "func", "body", "body_fun", "cond_fun",
+    "true_fun", "false_fun", "kernel",
+}
+# `map` alone is too generic to treat as a transform when called bare
+_REQUIRE_ATTR = {"map"}
+
+_HOST_NP_NAMES = {"np", "numpy"}
+_HOST_NP_ATTRS = {"asarray", "array", "float64", "float32", "concatenate",
+                  "stack", "item"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "callable", "getattr",
+                 "range", "enumerate", "sorted", "type"}
+_JNP_CONSTRUCTORS = {"asarray", "array", "zeros", "ones", "full", "arange",
+                     "linspace", "eye", "concatenate", "stack", "diag"}
+_HOT_RE = re.compile(r"(^|[^a-z])(hour|hr|day|date)s?([^a-z]|$)")
+
+
+def _base_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _root_name(expr: ast.expr) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _strip_partial(expr: ast.expr) -> ast.expr:
+    """functools.partial(f, ...) -> f (for transform-arg detection)."""
+    if (isinstance(expr, ast.Call) and _base_name(expr.func) == "partial"
+            and expr.args):
+        return expr.args[0]
+    return expr
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _has_static_marker(node: ast.AST) -> bool:
+    """Shape/dtype/None/len-style tests are resolved at trace time and
+    are legitimate Python branches inside traced code."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(n, ast.Call):
+            base = _base_name(n.func)
+            if base in _STATIC_CALLS:
+                return True
+        if isinstance(n, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+            for op in n.ops
+        ):
+            return True
+        if isinstance(n, ast.Constant) and n.value is None:
+            return True
+    return False
+
+
+def _source_line(lines: Sequence[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _shallow_walk(fnode: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested functions
+    (nested defs are visited as traced roots of their own)."""
+    body = fnode.body if isinstance(fnode.body, list) else [fnode.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: which function nodes are traced?
+# ---------------------------------------------------------------------------
+
+
+class _TracedRoots(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.traced_names: Set[str] = set()
+        self.traced_nodes: Set[int] = set()  # ids of Lambda/def nodes
+        self.f64_aliases: Set[str] = set()
+        self.has_x64_guard = False
+
+    def _mark(self, expr: ast.expr) -> None:
+        expr = _strip_partial(expr)
+        if isinstance(expr, ast.Name):
+            self.traced_names.add(expr.id)
+        elif isinstance(expr, ast.Lambda):
+            self.traced_nodes.add(id(expr))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        base = _base_name(node.func)
+        if base in _TRANSFORM_ARG_SLOTS and not (
+            base in _REQUIRE_ATTR and not isinstance(node.func, ast.Attribute)
+        ):
+            for slot in _TRANSFORM_ARG_SLOTS[base]:
+                if slot < len(node.args):
+                    self._mark(node.args[slot])
+            for kw in node.keywords:
+                if kw.arg in _TRANSFORM_FUNC_KWARGS:
+                    self._mark(kw.value)
+        self.generic_visit(node)
+
+    def _check_decorators(self, node) -> None:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            base = _base_name(_strip_partial(dec) if isinstance(dec, ast.Call)
+                              else target)
+            if base is None and isinstance(dec, ast.Call):
+                base = _base_name(dec.func)
+            if base in _TRANSFORM_ARG_SLOTS:
+                self.traced_nodes.add(id(node))
+            # @partial(jax.jit, ...) — partial's first arg is the transform
+            if (isinstance(dec, ast.Call)
+                    and _base_name(dec.func) == "partial" and dec.args
+                    and _base_name(dec.args[0]) in _TRANSFORM_ARG_SLOTS):
+                self.traced_nodes.add(id(node))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_decorators(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # f64 = jnp.float64 style aliases (GL005)
+        if (isinstance(node.value, ast.Attribute)
+                and node.value.attr in ("float64",)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.f64_aliases.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "jax_enable_x64":
+            self.has_x64_guard = True
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if node.value == "jax_enable_x64":
+            self.has_x64_guard = True
+
+
+# ---------------------------------------------------------------------------
+# pass 2: rule checks
+# ---------------------------------------------------------------------------
+
+
+class _Linter:
+    def __init__(self, tree: ast.Module, relpath: str, src: str) -> None:
+        self.relpath = relpath
+        self.lines = src.splitlines()
+        self.findings: List[Finding] = []
+        roots = _TracedRoots()
+        roots.visit(tree)
+        self.roots = roots
+        # resolve traced names to every def with that name (any scope)
+        for node in ast.walk(tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in roots.traced_names):
+                roots.traced_nodes.add(id(node))
+        self.tree = tree
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        self.findings.append(Finding(
+            path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            rule=rule, message=message,
+            source=_source_line(self.lines, line),
+        ))
+
+    def run(self) -> List[Finding]:
+        self._walk(self.tree, in_traced=False, hot_depth=0)
+        # dedupe (a node can be reachable twice through traced nesting)
+        seen: Set[tuple] = set()
+        out = []
+        for f in self.findings:
+            key = (f.rule, f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        out.sort(key=lambda f: (f.line, f.col, f.rule))
+        return out
+
+    # -- dispatch ------------------------------------------------------
+
+    def _walk(self, node: ast.AST, in_traced: bool, hot_depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                traced = in_traced or id(child) in self.roots.traced_nodes
+                if traced:
+                    self._check_traced_function(child)
+                # loops don't stay "hot" across a function boundary
+                self._walk(child, in_traced=traced, hot_depth=0)
+                continue
+            if isinstance(child, ast.Call):
+                self._check_call(child, in_traced, hot_depth)
+            if isinstance(child, (ast.For, ast.While)) and not in_traced:
+                hot = hot_depth + (1 if self._is_hot_loop(child) else 0)
+                self._walk(child, in_traced, hot)
+                continue
+            self._walk(child, in_traced, hot_depth)
+
+    # -- GL002 (+GL001 via _check_call during walk) --------------------
+
+    def _check_traced_function(self, fnode: ast.AST) -> None:
+        if isinstance(fnode, ast.Lambda):
+            params = {a.arg for a in fnode.args.args}
+        else:
+            args = fnode.args
+            params = {a.arg for a in
+                      args.posonlyargs + args.args + args.kwonlyargs}
+            if args.vararg:
+                params.add(args.vararg.arg)
+        params.discard("self")
+        params.discard("cls")
+        tainted = set(params)
+        shallow = list(_shallow_walk(fnode))
+        # fixpoint taint propagation through simple assignments
+        # (_shallow_walk order is not source order)
+        assigns = [n for n in shallow if isinstance(n, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if not (_names_in(node.value) & tainted):
+                    continue
+                # shape/len/dtype-derived values are static at trace
+                # time — branching on them later is legitimate
+                if _has_static_marker(node.value):
+                    continue
+                targets = []
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        targets.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(el.id for el in t.elts
+                                       if isinstance(el, ast.Name))
+                for name in targets:
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+        for node in shallow:
+            if isinstance(node, (ast.If, ast.While)):
+                hit = _names_in(node.test) & tainted
+                if hit and not _has_static_marker(node.test):
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    self._emit(
+                        node, "GL002",
+                        f"Python `{kind}` on `{sorted(hit)[0]}`, which "
+                        "derives from a traced argument — use jnp.where/"
+                        "lax.cond, or hoist the decision out of the "
+                        "traced function",
+                    )
+
+    # -- call-level rules ---------------------------------------------
+
+    def _check_call(self, node: ast.Call, in_traced: bool,
+                    hot_depth: int) -> None:
+        base = _base_name(node.func)
+
+        if in_traced:
+            self._check_gl001(node, base)
+        if hot_depth > 0 and not in_traced:
+            self._check_gl004(node, base)
+        self._check_gl003(node)
+        self._check_gl005(node, base)
+        self._check_gl006(node, base)
+
+    def _check_gl001(self, node: ast.Call, base: Optional[str]) -> None:
+        if (isinstance(node.func, ast.Name) and base in _HOST_CASTS
+                and node.args):
+            if all(isinstance(a, ast.Constant) for a in node.args):
+                return
+            if any(_has_static_marker(a) for a in node.args):
+                return
+            self._emit(
+                node, "GL001",
+                f"host `{base}()` on a non-constant value inside a "
+                "traced function — materializes the tracer; keep it a "
+                "jnp array (or hoist to the host caller)",
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            root = _root_name(node.func)
+            if root in _HOST_NP_NAMES and node.func.attr in _HOST_NP_ATTRS:
+                self._emit(
+                    node, "GL001",
+                    f"`{root}.{node.func.attr}()` inside a traced "
+                    "function — numpy pulls the tracer to the host; "
+                    "use the jnp equivalent",
+                )
+            elif node.func.attr in ("item", "tolist") and not node.args:
+                self._emit(
+                    node, "GL001",
+                    f"`.{node.func.attr}()` inside a traced function — "
+                    "host materialization of a traced value",
+                )
+
+    def _check_gl003(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            want_str = kw.arg == "static_argnames"
+            val = kw.value
+            elems = (val.elts if isinstance(val, (ast.Tuple, ast.List))
+                     else [val])
+            ok = all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, str if want_str else int)
+                for e in elems
+            )
+            if not ok:
+                self._emit(
+                    node, "GL003",
+                    f"`{kw.arg}` must be a literal "
+                    f"{'str/tuple-of-str' if want_str else 'int/tuple-of-int'}"
+                    " — computed or array-valued statics are unhashable "
+                    "or retrace per call",
+                )
+
+    def _is_hot_loop(self, node) -> bool:
+        if isinstance(node, ast.For):
+            text = (ast.unparse(node.target) + " " +
+                    ast.unparse(node.iter)).lower()
+        else:
+            text = ast.unparse(node.test).lower()
+        if _HOT_RE.search(text):
+            return True
+        # range(24) / range(8760): an hours-of-{day,year} sweep
+        for n in ast.walk(node.iter if isinstance(node, ast.For) else node.test):
+            if (isinstance(n, ast.Call) and _base_name(n.func) == "range"
+                    and n.args
+                    and isinstance(n.args[-1], ast.Constant)
+                    and n.args[-1].value in (24, 8760)):
+                return True
+        return False
+
+    def _check_gl004(self, node: ast.Call, base: Optional[str]) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if (_root_name(node.func) == "jnp"
+                and node.func.attr in _JNP_CONSTRUCTORS):
+            self._emit(
+                node, "GL004",
+                f"`jnp.{node.func.attr}()` inside a per-hour/per-day "
+                "host loop — each call is a device transfer; build the "
+                "array once outside the loop (or vmap over the axis)",
+            )
+
+    def _refs_float64(self, expr: ast.expr) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr == "float64":
+                return True
+            if isinstance(n, ast.Name) and (
+                    n.id == "float64" or n.id in self.roots.f64_aliases):
+                return True
+            if isinstance(n, ast.Constant) and n.value == "float64":
+                return True
+        return False
+
+    def _check_gl005(self, node: ast.Call, base: Optional[str]) -> None:
+        if self.roots.has_x64_guard:
+            return
+        if (isinstance(node.func, ast.Attribute) and base == "astype"
+                and node.args and self._refs_float64(node.args[0])):
+            self._emit(
+                node, "GL005",
+                "`astype(float64)` in a module that never consults "
+                "jax.config.jax_enable_x64 — under DISPATCHES_TPU_NO_X64 "
+                "this silently degrades to f32; guard or warn on the "
+                "x64 state",
+            )
+
+    def _flag_value(self, name: str, node: ast.AST) -> None:
+        if not name.startswith(_PREFIX):
+            return
+        short = name[len(_PREFIX):]
+        if short not in REGISTERED_FLAGS:
+            self._emit(
+                node, "GL006",
+                f"env flag `{name}` is not registered in "
+                "dispatches_tpu.analysis.flags.REGISTERED_FLAGS — add it "
+                "there (with a one-line meaning) in the same change",
+            )
+
+    def _check_gl006(self, node: ast.Call, base: Optional[str]) -> None:
+        is_environ_get = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "environ"
+        )
+        is_getenv = base == "getenv"
+        if (is_environ_get or is_getenv) and node.args and isinstance(
+                node.args[0], ast.Constant) and isinstance(
+                node.args[0].value, str):
+            self._flag_value(node.args[0].value, node)
+
+
+class _SubscriptFlags(ast.NodeVisitor):
+    """os.environ["DISPATCHES_TPU_X"] and `"..." in os.environ` (GL006
+    forms that aren't Call nodes)."""
+
+    def __init__(self, linter: _Linter) -> None:
+        self.linter = linter
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "environ"
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            self.linter._flag_value(node.slice.value, node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if (len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.comparators[0], ast.Attribute)
+                and node.comparators[0].attr == "environ"
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, str)):
+            self.linter._flag_value(node.left.value, node)
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(src: str, relpath: str) -> List[Finding]:
+    tree = ast.parse(src, filename=relpath)
+    linter = _Linter(tree, relpath, src)
+    findings = linter.run()
+    sub = _SubscriptFlags(linter)
+    linter.findings = []
+    sub.visit(tree)
+    findings.extend(linter.findings)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def package_root() -> Path:
+    """Directory containing the dispatches_tpu package."""
+    return Path(__file__).resolve().parent.parent
+
+
+def _relpath(path: Path) -> str:
+    root = package_root().parent
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def lint_file(path: Path) -> List[Finding]:
+    src = Path(path).read_text()
+    return lint_source(src, _relpath(Path(path)))
+
+
+def iter_py_files(paths: Sequence[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        else:
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: Path = DEFAULT_BASELINE) -> Counter:
+    """Multiset of fingerprints the repo has accepted as legacy."""
+    fps: Counter = Counter()
+    if not Path(path).exists():
+        return fps
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        fps[line.split()[0]] += 1
+    return fps
+
+
+def write_baseline(findings: Iterable[Finding],
+                   path: Path = DEFAULT_BASELINE) -> int:
+    lines = [
+        "# graftlint baseline — accepted legacy findings.",
+        "# Regenerate with: python -m dispatches_tpu.analysis "
+        "--write-baseline",
+        "# Only the first token (fingerprint) is compared; the rest is "
+        "for humans.",
+    ]
+    n = 0
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines.append(f"{f.fingerprint} {f.rule} {f.path}:{f.line} "
+                     f"{f.source[:100]}")
+        n += 1
+    Path(path).write_text("\n".join(lines) + "\n")
+    return n
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Counter) -> List[Finding]:
+    """Findings whose fingerprint exceeds its baseline multiplicity."""
+    remaining = Counter(baseline)
+    out = []
+    for f in findings:
+        if remaining[f.fingerprint] > 0:
+            remaining[f.fingerprint] -= 1
+        else:
+            out.append(f)
+    return out

@@ -1,0 +1,251 @@
+"""Runtime sanitizers: recompile accounting for ``jax.jit`` call sites
+and opt-in NaN/Inf guards on solver iterates.
+
+The package's whole performance contract is "lower once, reuse the
+compiled kernel" (PAPER.md §0) — a contract that is easy to break
+silently: a shape-dependent host branch, a weak-typed scalar, or a new
+static argument retraces on every call and the co-sim still produces
+correct numbers, just 100x slower.  ``graft_jit`` makes retraces
+observable (and assertable in tests via ``assert_no_recompiles``);
+``nan_guard`` makes non-finite iterates observable behind
+``DISPATCHES_TPU_SANITIZE`` without changing any call signature.
+
+Import discipline: this module is imported by ``core/compile.py`` and
+every solver module, so it must import nothing from ``dispatches_tpu``
+beyond the stdlib-only ``.flags`` registry (no circular imports).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import warnings
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_enabled
+
+__all__ = [
+    "RecompileWarning",
+    "SanitizeWarning",
+    "graft_jit",
+    "recompile_counts",
+    "reset_recompile_counts",
+    "assert_no_recompiles",
+    "sanitize_enabled",
+    "nan_guard",
+    "drain_sanitize_events",
+    "checkified",
+]
+
+
+class RecompileWarning(UserWarning):
+    """A graft_jit-wrapped callable was traced more than once."""
+
+
+class SanitizeWarning(UserWarning):
+    """A nan_guard observed a non-finite value in a guarded iterate."""
+
+
+# ---------------------------------------------------------------------------
+# recompile accounting
+# ---------------------------------------------------------------------------
+
+
+class _CompileCounter:
+    """Trace count for ONE jitted wrapper instance.
+
+    Counts are per instance, not per label: two Tracker objects each own
+    a jitted solver and each is expected to compile once — sharing a
+    count across them would flag legitimate first compiles as misses.
+    """
+
+    __slots__ = ("label", "count")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.count = 0
+
+
+_lock = threading.Lock()
+_COUNTERS: List[_CompileCounter] = []
+
+
+def graft_jit(fun: Callable, *, label: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with recompile accounting.
+
+    The wrapped function body runs exactly once per trace (= jit cache
+    miss), so counting calls of the pre-jit wrapper counts compiles.
+    Beyond the first trace, a warning is emitted when the
+    ``DISPATCHES_TPU_WARN_RECOMPILE`` flag is set; counts always feed
+    ``recompile_counts()`` / ``assert_no_recompiles()``.
+
+    The returned callable is a normal jitted function (``lower``,
+    ``clear_cache`` etc. all work) with a ``_graft_counter`` attribute
+    for introspection.
+    """
+    name = label or getattr(fun, "__name__", None) or repr(fun)
+    counter = _CompileCounter(name)
+    with _lock:
+        _COUNTERS.append(counter)
+
+    @functools.wraps(fun)
+    def _counted(*args, **kwargs):
+        counter.count += 1
+        if counter.count > 1 and flag_enabled("WARN_RECOMPILE"):
+            warnings.warn(
+                f"graftlint: '{counter.label}' was retraced "
+                f"(compile #{counter.count}) — jit cache miss after "
+                "warm-up; check for shape/dtype/static-arg churn",
+                RecompileWarning,
+                stacklevel=3,
+            )
+        return fun(*args, **kwargs)
+
+    jitted = jax.jit(_counted, **jit_kwargs)
+    jitted._graft_counter = counter
+    return jitted
+
+
+def recompile_counts() -> Dict[str, int]:
+    """Trace counts per wrapper, keyed ``label`` (``label#k`` on label
+    collisions, in registration order)."""
+    with _lock:
+        counters = list(_COUNTERS)
+    out: Dict[str, int] = {}
+    seen: Dict[str, int] = {}
+    for c in counters:
+        k = seen.get(c.label, 0)
+        seen[c.label] = k + 1
+        out[c.label if k == 0 else f"{c.label}#{k}"] = c.count
+    return out
+
+
+def reset_recompile_counts() -> None:
+    """Zero every counter and forget wrappers registered so far.
+
+    Counters stay attached to their (still live) wrappers, so a later
+    call of an old wrapper that retraces is still observable through its
+    ``_graft_counter``; the global report simply starts fresh.
+    """
+    with _lock:
+        for c in _COUNTERS:
+            c.count = 0
+        _COUNTERS.clear()
+
+
+@contextlib.contextmanager
+def assert_no_recompiles(allow: Tuple[str, ...] = ()):
+    """Assert no graft_jit wrapper traces inside the block.
+
+    Steady-state contract: after warm-up, a double-loop day must hit
+    the jit cache for every solver call — zero traces, including first
+    compiles of wrappers created inside the block (a new wrapper in
+    steady state IS a lowering the warm-up failed to amortize).
+    ``allow`` exempts labels that legitimately compile (e.g. a solver
+    for a new horizon requested mid-run).
+    """
+    with _lock:
+        before = {id(c): c.count for c in _COUNTERS}
+    yield
+    with _lock:
+        offending = [
+            (c.label, c.count - before.get(id(c), 0))
+            for c in _COUNTERS
+            if c.count > before.get(id(c), 0) and c.label not in allow
+        ]
+    if offending:
+        detail = ", ".join(f"{lbl}: +{n}" for lbl, n in offending)
+        raise AssertionError(
+            f"recompiles detected in steady state: {detail} "
+            "(every call should hit the jit cache after warm-up)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf guards (DISPATCHES_TPU_SANITIZE)
+# ---------------------------------------------------------------------------
+
+_EVENTS: List[str] = []
+
+
+def sanitize_enabled() -> bool:
+    """Whether nan_guard instruments traces (DISPATCHES_TPU_SANITIZE).
+
+    Read at TRACE time: flipping the flag after a solver is compiled
+    does not retroactively guard (or un-guard) its cached executable —
+    rebuild the solver after changing the flag.
+    """
+    return flag_enabled("SANITIZE")
+
+
+def _record(label: str, ok) -> None:
+    # host side of the guard; `ok` may be batched under vmap
+    if not bool(np.all(np.asarray(ok))):
+        with _lock:
+            _EVENTS.append(label)
+        warnings.warn(
+            f"graftlint sanitize: non-finite value in '{label}'",
+            SanitizeWarning,
+            stacklevel=2,
+        )
+
+
+def nan_guard(label: str, *arrays) -> None:
+    """Opt-in non-finite check on intermediate iterates.
+
+    No-op (zero trace and runtime cost) unless DISPATCHES_TPU_SANITIZE
+    is set at trace time.  When enabled, a ``jax.debug.callback``
+    records the label host-side and warns; events accumulate for
+    ``drain_sanitize_events``.  Safe inside ``lax.while_loop``/``scan``
+    bodies and under ``vmap``.
+    """
+    if not sanitize_enabled():
+        return
+    flat = [jnp.asarray(a) for a in arrays if a is not None]
+    if not flat:
+        return
+    ok = functools.reduce(
+        jnp.logical_and, [jnp.all(jnp.isfinite(a)) for a in flat]
+    )
+    jax.debug.callback(functools.partial(_record, label), ok)
+
+
+def drain_sanitize_events() -> List[str]:
+    """Return and clear the labels recorded by nan_guard callbacks.
+
+    Call ``jax.effects_barrier()`` (or block on outputs) first if the
+    guarded computation may still be in flight.
+    """
+    with _lock:
+        out = list(_EVENTS)
+        _EVENTS.clear()
+    return out
+
+
+def checkified(fun: Callable, errors: Optional[frozenset] = None) -> Callable:
+    """Wrap ``fun`` with ``jax.experimental.checkify`` NaN checks; the
+    returned callable raises ``JaxRuntimeError`` on the first NaN
+    instead of propagating it.
+
+    Heavier than ``nan_guard`` (instruments every primitive, so expect
+    noise from benign ±inf bound arithmetic in the solvers) — meant for
+    debugging a specific function, not for wiring into hot paths.
+    """
+    from jax.experimental import checkify
+
+    checked = checkify.checkify(
+        fun, errors=checkify.nan_checks if errors is None else errors
+    )
+
+    @functools.wraps(fun)
+    def run(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return run

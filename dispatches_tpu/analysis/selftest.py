@@ -1,0 +1,150 @@
+"""Self-test corpus: one minimal bad snippet per lint rule (must fire)
+and one near-miss good snippet (must stay clean).
+
+This is the linter's own regression net — `python -m
+dispatches_tpu.analysis --selftest` (and tests/test_analysis.py) fail
+if a rule stops firing on its canonical violation or starts flagging
+the disciplined version of the same code.
+"""
+
+from __future__ import annotations
+
+from textwrap import dedent
+from typing import Dict, List
+
+from dispatches_tpu.analysis.graftlint import RULES, lint_source
+
+CORPUS: Dict[str, Dict[str, str]] = {
+    "GL001": {
+        "bad": """
+            import jax
+            import numpy as np
+
+            def f(x):
+                y = np.asarray(x)
+                return float(x[0]) + y.item()
+
+            solve = jax.jit(f)
+        """,
+        "good": """
+            import jax
+            import jax.numpy as jnp
+
+            def f(x):
+                return jnp.asarray(x)[0] * 2.0
+
+            solve = jax.jit(f)
+        """,
+    },
+    "GL002": {
+        "bad": """
+            import jax
+
+            def f(x):
+                r = x * 2
+                if r > 0:
+                    return r
+                return -r
+
+            solve = jax.jit(f)
+        """,
+        "good": """
+            import jax
+            import jax.numpy as jnp
+
+            def f(x):
+                if x.ndim == 1:
+                    x = x[None, :]
+                return jnp.where(x > 0, x, -x)
+
+            solve = jax.jit(f)
+        """,
+    },
+    "GL003": {
+        "bad": """
+            import jax
+
+            nums = [0, 1]
+            solve = jax.jit(lambda a, b: a + b, static_argnums=nums)
+        """,
+        "good": """
+            import jax
+
+            solve = jax.jit(lambda a, b: a + b, static_argnums=(1,))
+        """,
+    },
+    "GL004": {
+        "bad": """
+            import jax.numpy as jnp
+
+            out = []
+            for hour in range(24):
+                out.append(jnp.asarray([float(hour), 1.0]))
+        """,
+        "good": """
+            import jax.numpy as jnp
+
+            hours = jnp.arange(24.0)
+            out = jnp.stack([hours, jnp.ones(24)], axis=1)
+        """,
+    },
+    "GL005": {
+        "bad": """
+            import jax.numpy as jnp
+
+            def polish(x):
+                f64 = jnp.float64
+                return x.astype(f64)
+        """,
+        "good": """
+            import jax
+            import jax.numpy as jnp
+            import warnings
+
+            def polish(x):
+                if not jax.config.jax_enable_x64:
+                    warnings.warn("polish needs x64")
+                return x.astype(jnp.float64)
+        """,
+    },
+    "GL006": {
+        "bad": """
+            import os
+
+            turbo = os.environ.get("DISPATCHES_TPU_TURBO")
+            if "DISPATCHES_TPU_LUDICROUS" in os.environ:
+                speed = os.environ["DISPATCHES_TPU_LUDICROUS"]
+        """,
+        "good": """
+            import os
+
+            slow = os.environ.get("DISPATCHES_TPU_SLOW")
+        """,
+    },
+}
+
+
+def run_selftest() -> List[str]:
+    """Lint every corpus snippet; return a list of failures (empty =
+    all rules fire on their bad snippet and stay quiet on the good
+    one)."""
+    errors: List[str] = []
+    for rule in RULES:
+        snippets = CORPUS.get(rule)
+        if snippets is None:
+            errors.append(f"{rule}: no self-test snippet in CORPUS")
+            continue
+        bad = lint_source(dedent(snippets["bad"]), f"<{rule}-bad>")
+        if not any(f.rule == rule for f in bad):
+            errors.append(
+                f"{rule}: did not fire on its bad snippet "
+                f"(got {[f.rule for f in bad]})"
+            )
+        good = lint_source(dedent(snippets["good"]), f"<{rule}-good>")
+        hits = [f for f in good if f.rule == rule]
+        if hits:
+            errors.append(
+                f"{rule}: false positive on its good snippet at "
+                f"line {hits[0].line}: {hits[0].message}"
+            )
+    return errors

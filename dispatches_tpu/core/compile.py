@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from dispatches_tpu.analysis.runtime import nan_guard
 from dispatches_tpu.core.graph import Flowsheet, Vals
 
 
@@ -119,6 +120,7 @@ class CompiledNLP:
         v = self._vals(x, params)
         p = Vals(params["p"])
         val = self._objective_fn(v, p)
+        nan_guard("nlp.objective", val)
         return -val if self.sense == "max" else val
 
     def user_objective(self, x: jnp.ndarray, params) -> jnp.ndarray:
@@ -132,18 +134,22 @@ class CompiledNLP:
             return jnp.zeros((0,), dtype=x.dtype)
         v = self._vals(x, params)
         p = Vals(params["p"])
-        return jnp.concatenate(
+        out = jnp.concatenate(
             [c.scale * self._ravel_tlast(c.fn(v, p)) for c in self._eq]
         )
+        nan_guard("nlp.eq", out)
+        return out
 
     def ineq(self, x: jnp.ndarray, params) -> jnp.ndarray:
         if not self._ineq:
             return jnp.zeros((0,), dtype=x.dtype)
         v = self._vals(x, params)
         p = Vals(params["p"])
-        return jnp.concatenate(
+        out = jnp.concatenate(
             [c.scale * self._ravel_tlast(c.fn(v, p)) for c in self._ineq]
         )
+        nan_guard("nlp.ineq", out)
+        return out
 
     # --- solution helpers --------------------------------------------
 

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.core.stacked import StackedScenarioNLP
 from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
 
@@ -86,7 +87,10 @@ class _BidderBase:
         )
         blk.solver_fn = make_ipm_solver(
             blk.stacked, IPMOptions(max_iter=self._max_iter))
-        blk.solve = jax.jit(blk.solver_fn)
+        blk.solve = graft_jit(
+            blk.solver_fn,
+            label=f"bidder.solve[h={horizon}]",
+        )
         return blk
 
     def _scenario_solve(self, blk, prices: np.ndarray):
@@ -143,6 +147,24 @@ class _BidderBase:
             for name, ov in overrides.items()
             if k == name or k.endswith("." + name)
         }
+        # an override that matches NO stacked-param key would otherwise
+        # vanish silently and every day would solve with the
+        # window-start state (exactly the bug class batch_day_params
+        # exists to prevent) — fail loudly instead
+        matched = {
+            name
+            for name in overrides
+            for k in params["p"]
+            if k == name or k.endswith("." + name)
+        }
+        unmatched = sorted(set(overrides) - matched)
+        if unmatched:
+            raise ValueError(
+                f"batch_day_params override(s) {unmatched} match no "
+                "stacked param key; known keys: "
+                f"{sorted(params['p'])} — the batched day solves would "
+                "silently reuse the window-start state"
+            )
         # the compiled D-wide batch solver is cached on the model block:
         # jit caches by function identity, so rebuilding vmap(...) per
         # rolling window would recompile the whole IPM batch every call
@@ -156,7 +178,10 @@ class _BidderBase:
                                   else None)
                               for k in params["p"]},
                         "fixed": None},)
-            vsolve = jax.jit(jax.vmap(blk.solver_fn, in_axes=in_axes))
+            vsolve = graft_jit(
+                jax.vmap(blk.solver_fn, in_axes=in_axes),
+                label=f"bidder.batch_solve[D={len(dates)}]",
+            )
             cache[ck] = vsolve
         arr = jnp.asarray(prices_days)
         if mesh is not None:

@@ -38,6 +38,17 @@ class DoubleLoopCoordinator:
         self.tracker = tracker
         self.projection_tracker = projection_tracker
         self._hour_in_day = 0
+        # each push_rt_dispatch implements n_tracking_hour hours; the
+        # day-boundary model update below consumes whole 24-h days, so
+        # a non-divisor stride would smear day boundaries across pushes
+        n_hr = int(getattr(tracker, "n_tracking_hour", 1))
+        if n_hr < 1 or 24 % n_hr != 0:
+            raise ValueError(
+                f"tracker.n_tracking_hour={n_hr} must divide 24: the "
+                "coordinator advances bidder models in whole-day "
+                "(24 h) increments of implemented profiles"
+            )
+        self._pushes_per_day = 24 // n_hr
 
     # -- identity ------------------------------------------------------
 
@@ -155,10 +166,13 @@ class DoubleLoopCoordinator:
         # last tracked hour would roll the window 1 h/day instead of
         # 24 (a drift the day-parallel parity test caught — the batched
         # path's per-day windows exposed the sequential lag)
-        self._hour_in_day += 1
+        self._hour_in_day += int(getattr(self.tracker, "n_tracking_hour", 1))
         if self._hour_in_day >= 24 and self.tracker.implemented_stats:
             self._hour_in_day = 0
-            day = self.tracker.implemented_stats[-24:]
+            # each implemented_stats entry covers n_tracking_hour hours,
+            # so one day is the last pushes_per_day ENTRIES (slicing 24
+            # entries would reach n_tracking_hour days back)
+            day = self.tracker.implemented_stats[-self._pushes_per_day:]
             profile = {k: [x for pr in day for x in pr[k]] for k in day[0]}
             self.bidder.update_day_ahead_model(**profile)
             self.bidder.update_real_time_model(**profile)

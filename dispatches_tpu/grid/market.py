@@ -608,12 +608,15 @@ class _DispatchLP:
             return cost
 
         self.nlp = fs.compile(objective=objective, sense="min")
+        from dispatches_tpu.analysis.runtime import graft_jit
+
         # autoscale off: clean duals (LMPs read directly off lam)
-        self._solve = jax.jit(
+        self._solve = graft_jit(
             make_ipm_solver(
                 self.nlp,
                 IPMOptions(max_iter=200, autoscale=False, kkt="dense"),
-            )
+            ),
+            label=f"market.sced[h={self.H}]",
         )
 
     def solve(self, params):

@@ -65,9 +65,12 @@ class Tracker:
 
         self.nlp = fs.compile(objective=objective, sense="min")
         self._solver = make_ipm_solver(self.nlp, IPMOptions(max_iter=max_iter))
-        import jax
+        from dispatches_tpu.analysis.runtime import graft_jit
 
-        self._solve = jax.jit(self._solver)
+        self._solve = graft_jit(
+            self._solver,
+            label=f"tracker.solve[h={self.tracking_horizon}]",
+        )
 
         self.power_output: Optional[np.ndarray] = None
         self.sol: Optional[dict] = None

@@ -46,6 +46,8 @@ import numpy as np
 
 from jax import lax
 
+from dispatches_tpu.analysis.runtime import nan_guard
+
 
 class IPMOptions(NamedTuple):
     tol: float = 1e-8
@@ -638,6 +640,7 @@ def make_ipm_solver(
         if opts.noimp_exit:
             done = done | (noimp >= opts.noimp_exit)
 
+        nan_guard("ipm.iterate", y_new, lam_new)
         return _State(
             y_new, lam_new, z_l_new, z_u_new, mu_new, state.it + 1, done, acc,
             err_chk, stall, alpha,

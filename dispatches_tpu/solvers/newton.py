@@ -29,12 +29,15 @@ loops in the reference :1314-1328).
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from dispatches_tpu.analysis.runtime import nan_guard
 
 
 @dataclass
@@ -97,6 +100,14 @@ def make_newton_solver(nlp, options: Optional[NewtonOptions] = None):
         solver_kind = (
             "refined_f32" if jax.default_backend() == "tpu" else "lu"
         )
+    if solver_kind == "refined_f32" and not jax.config.jax_enable_x64:
+        warnings.warn(
+            "NewtonOptions.linear_solver='refined_f32' with "
+            "jax_enable_x64 off: the f64 refinement step silently "
+            "degrades to f32 and refines nothing — enable x64 (unset "
+            "DISPATCHES_TPU_NO_X64) or expect f32-level residuals",
+            stacklevel=2,
+        )
     lin = (_linear_solve_refined if solver_kind == "refined_f32"
            else lambda J, r: jnp.linalg.solve(J, r))
 
@@ -144,6 +155,7 @@ def make_newton_solver(nlp, options: Optional[NewtonOptions] = None):
                 ls_cond, ls_body, (1.0, m1, 0)
             )
             x_new = jnp.clip(x + alpha * dx, lb, ub)
+            nan_guard("newton.iterate", x_new)
             return x_new, it + 1, jnp.max(jnp.abs(F(x_new)))
 
         def cond(state):

@@ -24,12 +24,15 @@ solve, so one compiled solver sweeps an LMP-scenario batch under
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from dispatches_tpu.analysis.runtime import nan_guard
 
 
 class LPResult(NamedTuple):
@@ -65,7 +68,14 @@ class PDLPOptions:
     #                              point (~1e-4 objective error) to ~1e-7
     #                              for ~4% extra FLOPs.  Guarded: the
     #                              polished point is kept only if its KKT
-    #                              error does not regress.
+    #                              error does not regress.  REQUIRES
+    #                              jax_enable_x64: with x64 off (e.g.
+    #                              DISPATCHES_TPU_NO_X64) every astype
+    #                              (float64) silently degrades to f32,
+    #                              the refinement step refines nothing,
+    #                              and the crossover adds FLOPs without
+    #                              accuracy — make_pdlp_solver warns and
+    #                              the KKT guard keeps the result sound.
     polish_act_tol: float = 1e-3  # relative activity threshold
     stall_min_iters: int = 2400  # earliest iteration at which the
     #                              stall ("floored") exit may fire
@@ -141,6 +151,14 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
     ``params`` inside the trace (cheap: one residual eval at x=0 plus
     one objective gradient)."""
     opt = options
+    if opt.polish and not jax.config.jax_enable_x64:
+        warnings.warn(
+            "PDLPOptions.polish=True with jax_enable_x64 off: the f64 "
+            "crossover factor/refinement silently degrades to f32 and "
+            "cannot lift the PDHG fixed point past ~1e-4 — enable x64 "
+            "(unset DISPATCHES_TPU_NO_X64) or drop polish",
+            stacklevel=2,
+        )
     dtype = jnp.dtype(opt.dtype)
     data = lp_data if lp_data is not None else make_lp_data(nlp)
     K, G = data["K"], data["G"]
@@ -310,6 +328,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             x1, z1, xs, zs = _pdhg_sweep(
                 s["x"], s["z"], s["xs"], s["zs"], c, b, s["omega"], opt.check_every
             )
+            nan_guard("pdlp.iterate", x1, z1)
             k = s["k"] + opt.check_every
             xa, za = xs / k, zs / k
             e_cur, _ = err_of(x1, z1)

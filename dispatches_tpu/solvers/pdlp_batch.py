@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dispatches_tpu.analysis.runtime import nan_guard
 from dispatches_tpu.solvers.pdlp import (
     LPResult,
     PDLPOptions,
@@ -320,6 +321,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             sig = (inv_step / s["omega"])[:, None]
             x1, z1, xs, zs = sweep(s["x"], s["z"], s["xs"], s["zs"],
                                    c, b, tau, sig)
+            nan_guard("pdlp_batch.iterate", x1, z1)
             k = s["k"] + opt.check_every
             xa, za = xs / k[:, None], zs / k[:, None]
             e_cur = _err(x1, z1, c, b)
@@ -408,6 +410,10 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             # that ran out the clock
             iters=jnp.where(out["done"], out["it_done"], out["it"]),
             pr_err=pr, du_err=du, gap=gap,
+            # row duals back in the ORIGINAL constraint space, per lane
+            # (same back-out as pdlp.py's z=zb*dr_j): shadow-price/LMP
+            # extraction works identically on both paths
+            z=zb * dr_j[None, :],
         )
 
     return solver

@@ -1,0 +1,428 @@
+"""graftlint + runtime sanitizers (dispatches_tpu.analysis).
+
+Three layers, matching the package:
+
+* the AST linter — every rule fires on its bad corpus snippet and stays
+  quiet on the good one, findings render ``path:line rule-id``, the
+  committed baseline grandfathers legacy findings without masking new
+  ones (fingerprints are line-number independent), and the CI entry
+  point ``python -m dispatches_tpu.analysis --check`` exits 0 on the
+  repo as committed;
+* ``graft_jit`` recompile accounting — trace counting, the
+  ``assert_no_recompiles`` steady-state assertion, and the
+  DISPATCHES_TPU_WARN_RECOMPILE flag;
+* ``nan_guard``/``checkified`` NaN sanitizers behind
+  DISPATCHES_TPU_SANITIZE (read at trace time).
+
+The capstone is the lower-once acceptance test: a 3-day double-loop
+co-sim (real MultiPeriodWindBattery operation models, no datasets) must
+run days 2-3 with ZERO retraces — one compile per solver callable,
+total, across DA bidding, RT bidding, and dispatch tracking.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.analysis import (
+    CORPUS,
+    DEFAULT_BASELINE,
+    RULES,
+    RecompileWarning,
+    SanitizeWarning,
+    assert_no_recompiles,
+    checkified,
+    drain_sanitize_events,
+    graft_jit,
+    lint_source,
+    load_baseline,
+    new_findings,
+    recompile_counts,
+    run_selftest,
+    write_baseline,
+)
+from dispatches_tpu.analysis.graftlint import lint_paths, package_root
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# linter rules + corpus
+# ---------------------------------------------------------------------------
+
+
+def test_selftest_corpus():
+    """Every rule fires on its bad snippet and not on its good one."""
+    assert run_selftest() == []
+
+
+def test_every_rule_has_corpus_snippets():
+    for rule in RULES:
+        assert rule in CORPUS, f"rule {rule} has no self-test snippets"
+        assert "bad" in CORPUS[rule] and "good" in CORPUS[rule]
+
+
+def test_finding_renders_path_line_rule():
+    src = textwrap.dedent(
+        """
+        import jax
+
+        def f(x):
+            return float(x) + 1.0
+
+        g = jax.jit(f)
+        """
+    )
+    findings = lint_source(src, "pkg/mod.py")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "GL001"
+    assert f.path == "pkg/mod.py"
+    assert f.line == 5
+    rendered = f.render()
+    assert rendered.startswith("pkg/mod.py:5")
+    assert "GL001" in rendered
+
+
+def test_baseline_survives_line_shifts(tmp_path):
+    """Fingerprints key on (path, rule, source text), not line numbers:
+    editing code ABOVE a baselined finding must not resurrect it."""
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+        "g = jax.jit(f)\n"
+    )
+    base_file = tmp_path / "baseline"
+    write_baseline(lint_source(src, "m.py"), base_file)
+
+    shifted = "# comment\n# more\n\n" + src
+    fresh = new_findings(lint_source(shifted, "m.py"), load_baseline(base_file))
+    assert fresh == []
+
+
+def test_baseline_does_not_mask_new_findings(tmp_path):
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+        "g = jax.jit(f)\n"
+    )
+    base_file = tmp_path / "baseline"
+    write_baseline(lint_source(src, "m.py"), base_file)
+
+    # a second, distinct violation in the same file must surface
+    grown = src + "def h(x):\n    return x.item()\nk = jax.jit(h)\n"
+    fresh = new_findings(lint_source(grown, "m.py"), load_baseline(base_file))
+    assert len(fresh) == 1
+    assert fresh[0].line == 6
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    """In-process equivalent of ``--check``: the package as committed
+    has no findings beyond the baseline (CI gate)."""
+    findings = lint_paths([package_root()])
+    fresh = new_findings(findings, load_baseline(DEFAULT_BASELINE))
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
+def test_cli_check_exits_zero():
+    """The acceptance-criteria command, exactly as CI runs it."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dispatches_tpu.analysis", "--check"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout
+
+
+def test_cli_check_fails_on_new_violation(tmp_path):
+    bad = tmp_path / "fresh_violation.py"
+    bad.write_text(
+        "import jax\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+        "g = jax.jit(f)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "dispatches_tpu.analysis", "--check",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "GL002" in proc.stdout
+
+
+def test_gl004_hot_loop_and_gl006_flags():
+    src = textwrap.dedent(
+        """
+        import os
+        import jax.numpy as jnp
+
+        def build(days):
+            for hour in range(24):
+                a = jnp.zeros(4)
+            return os.environ.get("DISPATCHES_TPU_FRBNZ")
+        """
+    )
+    rules = sorted(f.rule for f in lint_source(src, "m.py"))
+    assert rules == ["GL004", "GL006"]
+
+
+# ---------------------------------------------------------------------------
+# graft_jit recompile accounting
+# ---------------------------------------------------------------------------
+
+
+def test_graft_jit_counts_traces():
+    f = graft_jit(lambda x: x * 2.0, label="t.double")
+    a = jnp.arange(4.0)
+    np.testing.assert_allclose(np.asarray(f(a)), np.asarray(a) * 2)
+    f(a + 1.0)  # same shape/dtype: cache hit
+    assert f._graft_counter.count == 1
+    f(jnp.arange(8.0))  # new shape: retrace
+    assert f._graft_counter.count == 2
+    assert recompile_counts()["t.double"] == 2
+
+
+def test_graft_jit_label_collision_keys():
+    g1 = graft_jit(lambda x: x + 1, label="t.same")
+    g2 = graft_jit(lambda x: x + 2, label="t.same")
+    g1(jnp.zeros(2))
+    counts = recompile_counts()
+    # per-instance counters: the second wrapper never traced
+    assert counts["t.same"] == 1
+    assert counts["t.same#1"] == 0
+    g2(jnp.zeros(2))
+    assert recompile_counts()["t.same#1"] == 1
+
+
+def test_assert_no_recompiles_passes_on_cache_hits():
+    f = graft_jit(lambda x: x - 1.0, label="t.steady")
+    f(jnp.zeros(3))  # warm-up
+    with assert_no_recompiles():
+        for _ in range(4):
+            f(jnp.ones(3))
+    assert f._graft_counter.count == 1
+
+
+def test_assert_no_recompiles_raises_on_retrace():
+    f = graft_jit(lambda x: x * 3.0, label="t.churn")
+    f(jnp.zeros(3))
+    with pytest.raises(AssertionError, match="t.churn"):
+        with assert_no_recompiles():
+            f(jnp.zeros(5))  # shape churn retraces
+
+
+def test_assert_no_recompiles_catches_new_wrapper_inside_block():
+    with pytest.raises(AssertionError, match="t.late"):
+        with assert_no_recompiles():
+            f = graft_jit(lambda x: x, label="t.late")
+            f(jnp.zeros(2))  # first compile, but in steady state
+
+
+def test_assert_no_recompiles_allow_exempts_label():
+    f = graft_jit(lambda x: x, label="t.exempt")
+    with assert_no_recompiles(allow=("t.exempt",)):
+        f(jnp.zeros(2))
+
+
+def test_warn_recompile_flag(monkeypatch):
+    f = graft_jit(lambda x: x + 5.0, label="t.warn")
+    f(jnp.zeros(2))
+    monkeypatch.setenv("DISPATCHES_TPU_WARN_RECOMPILE", "1")
+    with pytest.warns(RecompileWarning, match="t.warn"):
+        f(jnp.zeros(7))
+
+
+# ---------------------------------------------------------------------------
+# NaN sanitizers (DISPATCHES_TPU_SANITIZE)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_guard_noop_without_flag(monkeypatch):
+    monkeypatch.delenv("DISPATCHES_TPU_SANITIZE", raising=False)
+    from dispatches_tpu.analysis.runtime import nan_guard
+
+    def f(x):
+        nan_guard("t.off", x)
+        return x * 2.0
+
+    out = jax.jit(f)(jnp.array([1.0, jnp.nan]))
+    jax.effects_barrier()
+    assert drain_sanitize_events() == []
+    assert np.isnan(np.asarray(out)[1])
+
+
+def test_nan_guard_records_when_enabled(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_SANITIZE", "1")
+    from dispatches_tpu.analysis.runtime import nan_guard
+
+    # flag is read at TRACE time: define the guarded fn under the flag
+    def f(x):
+        nan_guard("t.guard", x)
+        return x * 2.0
+
+    jf = jax.jit(f)
+    drain_sanitize_events()
+    with pytest.warns(SanitizeWarning, match="t.guard"):
+        jf(jnp.array([1.0, jnp.nan]))
+        jax.effects_barrier()
+    assert drain_sanitize_events() == ["t.guard"]
+
+    # finite inputs on the SAME cached executable stay silent
+    jf(jnp.array([1.0, 2.0]))
+    jax.effects_barrier()
+    assert drain_sanitize_events() == []
+
+
+def test_nan_guard_solver_iterates(monkeypatch):
+    """End-to-end: a NaN parameter poisons the IPM iterates and the
+    guard inside the jitted solver loop reports it."""
+    monkeypatch.setenv("DISPATCHES_TPU_SANITIZE", "1")
+    from dispatches_tpu import Flowsheet
+    from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+    fs = Flowsheet(horizon=4)
+    fs.add_var("x", lb=0, ub=10)
+    fs.add_param("target", np.full(4, 2.0))
+    fs.add_eq("pin", lambda v, p: v["x"] - p["target"])
+    nlp = fs.compile(objective=lambda v, p: jnp.sum(v["x"] ** 2))
+    solver = jax.jit(make_ipm_solver(nlp, IPMOptions(max_iter=10)))
+
+    params = nlp.default_params()
+    params["p"]["target"] = np.array([2.0, np.nan, 2.0, 2.0])
+    drain_sanitize_events()
+    with pytest.warns(SanitizeWarning):
+        solver(params)
+        jax.effects_barrier()
+    assert any(e.startswith("nlp.") or e.startswith("ipm.")
+               for e in drain_sanitize_events())
+
+
+def test_checkified_raises_on_nan():
+    def f(x):
+        return jnp.log(x)
+
+    cf = checkified(f)
+    np.testing.assert_allclose(np.asarray(cf(jnp.array([1.0]))), [0.0])
+    with pytest.raises(Exception, match="nan"):
+        cf(jnp.array([-1.0]))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 3-day double-loop steady state, zero recompiles
+# ---------------------------------------------------------------------------
+
+
+def _wind_battery_coordinator(n_tracking_hour=2, da_horizon=8, rt_horizon=4):
+    from dispatches_tpu.case_studies.renewables.wind_battery_double_loop import (
+        MultiPeriodWindBattery,
+    )
+    from dispatches_tpu.grid import (
+        DoubleLoopCoordinator,
+        RenewableGeneratorModelData,
+        SelfScheduler,
+        Tracker,
+    )
+
+    rng = np.random.default_rng(7)
+    cfs = 0.3 + 0.4 * rng.random(24 * 4)
+    md = RenewableGeneratorModelData(
+        gen_name="4_WIND", bus="4", p_min=0.0, p_max=120.0
+    )
+
+    def make_mp():
+        return MultiPeriodWindBattery(
+            model_data=md,
+            wind_capacity_factors=cfs,
+            wind_pmax_mw=120,
+            battery_pmax_mw=15,
+            battery_energy_capacity_mwh=60,
+        )
+
+    class _Forecaster:
+        # deterministic, stateless: steady-state bids re-solve the same
+        # SHAPES every day (values may drift; shapes must not)
+        def forecast_day_ahead_prices(self, date, hour, bus, horizon, n):
+            base = 25.0 + 5.0 * np.sin(np.arange(horizon) + hour)
+            return np.stack([base * (1.0 + 0.1 * s) for s in range(n)])
+
+        forecast_real_time_prices = forecast_day_ahead_prices
+
+    bidder = SelfScheduler(
+        bidding_model_object=make_mp(),
+        day_ahead_horizon=da_horizon,
+        real_time_horizon=rt_horizon,
+        n_scenario=1,
+        forecaster=_Forecaster(),
+        max_iter=120,
+    )
+    tracker = Tracker(
+        tracking_model_object=make_mp(),
+        tracking_horizon=rt_horizon,
+        n_tracking_hour=n_tracking_hour,
+        max_iter=120,
+    )
+    projection = Tracker(
+        tracking_model_object=make_mp(),
+        tracking_horizon=da_horizon,
+        n_tracking_hour=n_tracking_hour,
+        max_iter=120,
+    )
+    return DoubleLoopCoordinator(bidder, tracker, projection)
+
+
+def _run_day(coord, date, pushes_per_day, n_hr):
+    coord.request_da_bids(date)
+    for k in range(pushes_per_day):
+        hour = k * n_hr
+        bids = coord.request_rt_bids(date, hour)
+        dispatch = bids[0]["4_WIND"]["p_max"]
+        coord.push_rt_dispatch(date, hour, dispatch, {"4": 27.0})
+
+
+def test_double_loop_steady_state_no_recompiles():
+    """ISSUE acceptance: after a 1-day warm-up, TWO more full co-sim
+    days (DA bid solve + 12 RT bid solves + 12 tracking solves each,
+    n_tracking_hour=2, with the day-boundary model re-sync in between)
+    execute with zero jit retraces — one compile per solver callable
+    over the whole 3-day run."""
+    n_hr = 2
+    coord = _wind_battery_coordinator(n_tracking_hour=n_hr)
+    pushes = coord._pushes_per_day
+    assert pushes == 12
+
+    dates = [f"2020-07-1{k}" for k in range(3)]
+    _run_day(coord, dates[0], pushes, n_hr)  # warm-up: compiles happen here
+
+    da_solve = coord.bidder.day_ahead_model.solve
+    rt_solve = coord.bidder.real_time_model.solve
+    tr_solve = coord.tracker._solve
+    assert da_solve._graft_counter.count == 1
+    assert rt_solve._graft_counter.count == 1
+    assert tr_solve._graft_counter.count == 1
+
+    with assert_no_recompiles():
+        for date in dates[1:]:
+            _run_day(coord, date, pushes, n_hr)
+
+    # one compile per callable over all 3 days; the projection tracker
+    # was never solved (no DA settlement pushed) and must stay cold
+    assert da_solve._graft_counter.count == 1
+    assert rt_solve._graft_counter.count == 1
+    assert tr_solve._graft_counter.count == 1
+    assert coord.projection_tracker._solve._graft_counter.count == 0
+
+    # and the co-sim actually progressed: 36 tracked pushes implementing
+    # 72 hours, with day-boundary model updates rolling the CF window
+    assert len(coord.tracker.implemented_stats) == 3 * pushes
+    assert coord.bidder.day_ahead_model._time_idx == 3 * 24

@@ -4,6 +4,7 @@ SelfScheduler/Bidder directly with a Backcaster built from historical
 prices — the market is mocked by data, not simulated (SURVEY.md §4)."""
 
 from pathlib import Path
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -164,6 +165,79 @@ def test_thermal_bidder_curves(wind_df):
         costs = [c for _, c in curve]
         assert powers == sorted(powers)
         assert costs == sorted(costs)
+
+
+class _FakeTracker:
+    """Duck-typed tracker: each push implements ``n_tracking_hour``
+    consecutive hour indices, so the coordinator's day-boundary slice
+    is directly observable."""
+
+    def __init__(self, n_tracking_hour, tracking_horizon=4):
+        self.n_tracking_hour = n_tracking_hour
+        self.tracking_horizon = tracking_horizon
+        self.implemented_stats = []
+
+    def track_market_dispatch(self, signal, date=None, hour=None):
+        h = self.n_tracking_hour
+        base = len(self.implemented_stats) * h
+        self.implemented_stats.append(
+            {"realized_soc": [float(base + i) for i in range(h)]}
+        )
+
+    def get_last_delivered_power(self):
+        return 0.0
+
+
+class _FakeBidder:
+    def __init__(self):
+        self.updates = []
+        md = SimpleNamespace(gen_name="G", bus="b")
+        self.bidding_model_object = SimpleNamespace(model_data=md)
+        self.forecaster = SimpleNamespace()
+
+    def update_day_ahead_model(self, **profile):
+        self.updates.append(profile)
+
+    def update_real_time_model(self, **profile):
+        pass
+
+
+def test_coordinator_day_boundary_slice_n_tracking_hour_2():
+    """Regression (multi-hour tracking strides): with n_tracking_hour=2
+    a day is the last 12 implemented ENTRIES (24 hours) — slicing 24
+    entries would reach two days back and re-implement stale hours."""
+    from dispatches_tpu.grid import DoubleLoopCoordinator
+
+    bidder = _FakeBidder()
+    coord = DoubleLoopCoordinator(bidder, _FakeTracker(2), _FakeTracker(2))
+    assert coord._pushes_per_day == 12
+
+    for day in range(2):
+        for k in range(12):
+            coord.push_rt_dispatch("2020-07-10", 2 * k, 50.0, {})
+        assert len(bidder.updates) == day + 1
+        got = bidder.updates[day]["realized_soc"]
+        # exactly THIS day's 24 hour indices, in order
+        assert got == [float(24 * day + i) for i in range(24)]
+
+
+def test_coordinator_hourly_slice_unchanged():
+    """n_tracking_hour=1 keeps the original 24-entry day slice."""
+    from dispatches_tpu.grid import DoubleLoopCoordinator
+
+    bidder = _FakeBidder()
+    coord = DoubleLoopCoordinator(bidder, _FakeTracker(1), _FakeTracker(1))
+    assert coord._pushes_per_day == 24
+    for k in range(24):
+        coord.push_rt_dispatch("2020-07-10", k, 50.0, {})
+    assert bidder.updates[0]["realized_soc"] == [float(i) for i in range(24)]
+
+
+def test_coordinator_rejects_non_divisor_tracking_stride():
+    from dispatches_tpu.grid import DoubleLoopCoordinator
+
+    with pytest.raises(ValueError, match="n_tracking_hour=5"):
+        DoubleLoopCoordinator(_FakeBidder(), _FakeTracker(5), _FakeTracker(5))
 
 
 def test_backcaster_shapes():

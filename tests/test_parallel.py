@@ -183,6 +183,50 @@ def test_day_parallel_bids_match_sequential():
             )
 
 
+def test_batch_day_params_unmatched_override_raises():
+    """A ``batch_day_params`` override that matches no stacked param key
+    must fail loudly: silently dropping it would solve every day of the
+    window with the window-start state (the exact bug class the per-day
+    overrides exist to prevent)."""
+    from dispatches_tpu.case_studies.renewables.wind_battery_double_loop import (
+        MultiPeriodWindBattery,
+    )
+    from dispatches_tpu.grid import RenewableGeneratorModelData, SelfScheduler
+
+    rng = np.random.default_rng(9)
+    horizon = 4
+    md = RenewableGeneratorModelData(
+        gen_name="4_WIND", bus="4", p_min=0.0, p_max=120.0
+    )
+    mp = MultiPeriodWindBattery(
+        model_data=md,
+        wind_capacity_factors=0.3 + 0.4 * rng.random(48),
+        wind_pmax_mw=120,
+        battery_pmax_mw=15,
+        battery_energy_capacity_mwh=60,
+    )
+
+    class Forecaster:
+        def forecast_day_ahead_prices(self, date, hour, bus, horizon, n):
+            return 25.0 + np.zeros((n, horizon))
+
+        forecast_real_time_prices = forecast_day_ahead_prices
+
+    bidder = SelfScheduler(
+        bidding_model_object=mp,
+        day_ahead_horizon=horizon,
+        real_time_horizon=horizon,
+        n_scenario=1,
+        forecaster=Forecaster(),
+        max_iter=20,
+    )
+    mp.batch_day_params = lambda blk, n_days: {
+        "capacity_factor_typo": np.zeros((n_days, horizon))
+    }
+    with pytest.raises(ValueError, match="capacity_factor_typo"):
+        bidder.compute_day_ahead_bids_batch(["2020-07-10", "2020-07-11"])
+
+
 def test_annual_366_scenario_sharded_lp_sweep():
     """Realistic-scale sharding (VERDICT r3 weak #8): the full 366-day
     annual LMP sweep of the PRODUCTION 24-h wind+battery price-taker,
