@@ -405,6 +405,58 @@ def run_bench():
         vs_baseline=round(peak_sps * serial_per_solve, 2),
     )
 
+    # ---- serve-layer overhead: N staggered single requests through
+    # the micro-batching SolveService vs the same N solved as one
+    # pre-batched slab through the same kernel.  The interesting
+    # numbers are the throughput ratio (queueing + stack/slice cost)
+    # and that the request stream holds full occupancy with the
+    # expected handful of compiled programs --------------------------
+    try:
+        from dispatches_tpu.serve import ServeOptions, SolveService
+
+        n_serve = 256 if backend != "cpu" else 32
+        serve_batch = 64 if backend != "cpu" else 16
+        lmps_s, cfs_s = _scenarios(n_serve, np.random.default_rng(7))
+        serve_opts = {"tol": 1e-5, "dtype": "float32"}
+        svc = SolveService(ServeOptions(
+            max_batch=serve_batch, max_wait_ms=1e9, warm_start=False))
+        plist = [
+            {"p": {**params["p"], "lmp": jnp.asarray(lmps_s[i] * 1e-3),
+                   "windpower.capacity_factor": jnp.asarray(cfs_s[i])},
+             "fixed": params["fixed"]}
+            for i in range(n_serve)
+        ]
+        # warm the bucket's full-lane program (n_serve is a multiple of
+        # max_batch, so the measured round dispatches full lanes only)
+        svc.solve_many(nlp, plist[:serve_batch], solver="pdlp",
+                       options=serve_opts)
+        t0 = time.perf_counter()
+        rs = svc.solve_many(nlp, plist, solver="pdlp", options=serve_opts)
+        serve_s = time.perf_counter() - t0
+        sm = svc.metrics()
+
+        slab = jax.jit(jax.vmap(
+            make_pdlp_solver(nlp, PDLPOptions(**serve_opts)),
+            in_axes=in_axes))
+        bp = batched_params(lmps_s, cfs_s)
+        jax.block_until_ready(slab(bp))  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(slab(bp))
+        slab_s = time.perf_counter() - t0
+        out["serve"] = {
+            "n_requests": n_serve,
+            "max_batch": serve_batch,
+            "requests_done": sum(r.status == "DONE" for r in rs),
+            "solves_per_sec": round(n_serve / serve_s, 2),
+            "slab_solves_per_sec": round(n_serve / slab_s, 2),
+            "overhead_vs_slab": round(serve_s / slab_s, 3),
+            "occupancy_mean": sm["occupancy_mean"],
+            "compile_count": sm["compile_count"],
+            "programs": sm["programs"],
+        }
+    except Exception as exc:  # telemetry must never kill the headline
+        out["serve_bench_error"] = str(exc)[:120]
+
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------
     if backend == "cpu":

@@ -29,6 +29,12 @@ REGISTERED_FLAGS = {
     "(analysis.runtime.nan_guard; read at trace time)",
     "WARN_RECOMPILE": "warn whenever a graft_jit-wrapped callable "
     "retraces after its first compile",
+    "SERVE_MAX_BATCH": "solve-service flush threshold / max lanes per "
+    "dispatched batch (serve.ServeOptions.from_env)",
+    "SERVE_MAX_WAIT_MS": "solve-service max age of the oldest queued "
+    "request before a forced flush (serve.ServeOptions.from_env)",
+    "SERVE_MAX_QUEUE": "solve-service total pending-request bound; a "
+    "full queue flushes oldest-first (serve.ServeOptions.from_env)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -43,6 +43,7 @@ class _BidderBase:
         solver=None,
         forecaster=None,
         max_iter: int = 300,
+        solve_service=None,
     ):
         self.bidding_model_object = bidding_model_object
         self.day_ahead_horizon = int(day_ahead_horizon)
@@ -52,6 +53,11 @@ class _BidderBase:
         self.generator = bidding_model_object.model_data.gen_name
         self.bids_result_list = []
         self._max_iter = max_iter
+        #: opt-in micro-batching: when a ``dispatches_tpu.serve.
+        #: SolveService`` is supplied, per-scenario stacked solves route
+        #: through it (bucketed on this bidder's already-built solver),
+        #: so many bidders sharing one service dispatch as one batch
+        self.solve_service = solve_service
 
         self.day_ahead_model = self._build(self.day_ahead_horizon)
         self.real_time_model = self._build(self.real_time_horizon)
@@ -87,10 +93,24 @@ class _BidderBase:
         )
         blk.solver_fn = make_ipm_solver(
             blk.stacked, IPMOptions(max_iter=self._max_iter))
-        blk.solve = graft_jit(
-            blk.solver_fn,
-            label=f"bidder.solve[h={horizon}]",
-        )
+        if self.solve_service is not None:
+            # route through the shared micro-batching service, reusing
+            # the solver built above (base_solver buckets by identity,
+            # so DA/RT horizons land in separate shape buckets)
+            service, stacked, solver_fn = (
+                self.solve_service, blk.stacked, blk.solver_fn)
+
+            def _service_solve(batched):
+                return service.solve(
+                    stacked, params=batched, solver="ipm",
+                    base_solver=solver_fn)
+
+            blk.solve = _service_solve
+        else:
+            blk.solve = graft_jit(
+                blk.solver_fn,
+                label=f"bidder.solve[h={horizon}]",
+            )
         return blk
 
     def _scenario_solve(self, blk, prices: np.ndarray):

@@ -1,0 +1,34 @@
+"""Micro-batching solve service: turns the batch kernels into a
+request-serving layer (see docs/serve.md)."""
+
+from dispatches_tpu.serve.bucket import (
+    lane_menu,
+    pad_lanes,
+    params_signature,
+    request_fingerprint,
+)
+from dispatches_tpu.serve.metrics import format_stats
+from dispatches_tpu.serve.service import (
+    RequestStatus,
+    ServeOptions,
+    ServeResult,
+    SolveHandle,
+    SolveService,
+    get_default_service,
+    set_default_service,
+)
+
+__all__ = [
+    "RequestStatus",
+    "ServeOptions",
+    "ServeResult",
+    "SolveHandle",
+    "SolveService",
+    "format_stats",
+    "get_default_service",
+    "lane_menu",
+    "pad_lanes",
+    "params_signature",
+    "request_fingerprint",
+    "set_default_service",
+]

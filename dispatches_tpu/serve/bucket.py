@@ -1,0 +1,78 @@
+"""Shape bucketing for the solve service: compiled-program fingerprints
+and the padded-lane policy.
+
+The service's contract with the XLA compilation model is that every
+dispatched batch replays an already-lowered program.  Two requests may
+share a compiled program iff they agree on (a) the NLP object (its
+lowering IS the program), (b) the resolved solver kind and frozen
+options (baked into the trace), and (c) the abstract signature of their
+params pytree (structure + per-leaf shape/dtype — what ``jax.jit``
+keys its cache on).  That triple is the *bucket fingerprint*; within a
+bucket only the lane count (batch width) can vary, and it is snapped to
+a small fixed menu of power-of-two widths so a bucket compiles a
+handful of programs once and then replays forever.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+def freeze_options(options) -> Tuple:
+    """Hashable, order-independent form of a solver-options dict."""
+    return tuple(sorted((options or {}).items()))
+
+
+def params_signature(params) -> Tuple:
+    """Abstract signature of a params pytree: structure plus per-leaf
+    (shape, dtype).  Two requests with equal signatures stack into one
+    batch and hit the same jit cache entry."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    leaf_sig = tuple(
+        (tuple(np.shape(leaf)), np.asarray(leaf).dtype.str) for leaf in leaves
+    )
+    return (treedef, leaf_sig)
+
+
+def request_fingerprint(params) -> str:
+    """Content hash of a params pytree (structure + leaf bytes) — the
+    per-request identity the warm-start cache is keyed by.  Unlike
+    :func:`params_signature` this distinguishes *values*, so a repeat
+    of the same request warm-starts from its previous solution."""
+    h = hashlib.blake2b(digest_size=16)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(arr.dtype.str.encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def lane_menu(max_batch: int) -> Tuple[int, ...]:
+    """The fixed menu of padded lane counts for a bucket: powers of two
+    up to ``max_batch``, plus ``max_batch`` itself when it is not a
+    power of two.  Small menu == few compiles; power-of-two widths keep
+    the MXU/VPU lane dimension aligned."""
+    menu = []
+    w = 1
+    while w < max_batch:
+        menu.append(w)
+        w *= 2
+    menu.append(max_batch)
+    return tuple(menu)
+
+
+def pad_lanes(n_live: int, max_batch: int) -> int:
+    """Padded lane count for a batch of ``n_live`` requests: the
+    smallest menu entry >= n_live (callers cap batches at max_batch)."""
+    if n_live > max_batch:
+        raise ValueError(f"batch of {n_live} exceeds max_batch={max_batch}")
+    for w in lane_menu(max_batch):
+        if w >= n_live:
+            return w
+    return max_batch

@@ -1,0 +1,121 @@
+"""Service telemetry: per-bucket counters, latency quantiles, and the
+``--stats`` text report.
+
+Everything here is plain host-side bookkeeping (no JAX): the service
+records events as they happen and :func:`format_stats` renders the
+metrics dict the way the reference's solver logs render iteration
+tables — a fixed-width text block an operator can tail.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class LatencyWindow:
+    """Sliding window of request latencies (ms) with cheap quantiles."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._window = deque(maxlen=maxlen)
+        self.count = 0
+        self.total_ms = 0.0
+
+    def record(self, latency_ms: float) -> None:
+        self._window.append(float(latency_ms))
+        self.count += 1
+        self.total_ms += float(latency_ms)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._window:
+            return None
+        xs = sorted(self._window)
+        idx = min(int(q * len(xs)), len(xs) - 1)
+        return xs[idx]
+
+    def summary(self) -> Dict[str, float]:
+        out = {"count": self.count}
+        if self._window:
+            out["mean_ms"] = round(self.total_ms / max(self.count, 1), 3)
+            out["p50_ms"] = round(self.quantile(0.50), 3)
+            out["p99_ms"] = round(self.quantile(0.99), 3)
+        return out
+
+
+class BucketStats:
+    """Counters for one shape bucket."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.submitted = 0
+        self.solved = 0
+        self.timeouts = 0
+        self.batches = 0
+        self.lanes_dispatched = 0   # padded lanes summed over batches
+        self.live_dispatched = 0    # real (unpadded) requests dispatched
+        self.lane_counts: List[int] = []  # distinct padded widths seen
+
+    def record_batch(self, n_live: int, lanes: int) -> None:
+        self.batches += 1
+        self.live_dispatched += n_live
+        self.lanes_dispatched += lanes
+        if lanes not in self.lane_counts:
+            self.lane_counts.append(lanes)
+
+    @property
+    def occupancy(self) -> Optional[float]:
+        if not self.lanes_dispatched:
+            return None
+        return self.live_dispatched / self.lanes_dispatched
+
+    def as_dict(self, compiles: int) -> Dict:
+        return {
+            "submitted": self.submitted,
+            "solved": self.solved,
+            "timeouts": self.timeouts,
+            "batches": self.batches,
+            "lane_counts": sorted(self.lane_counts),
+            "occupancy": (round(self.occupancy, 4)
+                          if self.occupancy is not None else None),
+            "compiles": compiles,
+        }
+
+
+def format_stats(metrics: Dict) -> str:
+    """Render ``SolveService.metrics()`` as the ``--stats`` text report."""
+    lines = ["== dispatches_tpu.serve stats =="]
+    lines.append(
+        "requests: {submitted} submitted / {solved} solved / "
+        "{timeouts} timed out; queue depth {queue_depth}".format(**metrics)
+    )
+    lines.append(
+        "batches: {batches} dispatched, mean occupancy {occ}; "
+        "compiled programs: {compile_count}".format(
+            batches=metrics["batches"],
+            occ=("%.3f" % metrics["occupancy_mean"]
+                 if metrics["occupancy_mean"] is not None else "n/a"),
+            compile_count=metrics["compile_count"],
+        )
+    )
+    lat = metrics["latency"]
+    if lat.get("count"):
+        lines.append(
+            "latency: mean {mean_ms} ms, p50 {p50_ms} ms, p99 {p99_ms} ms "
+            "over {count} request(s)".format(**lat)
+        )
+    ws = metrics["warm_start"]
+    lines.append(
+        "warm starts: {hits} hit(s) / {misses} miss(es), "
+        "{size} cached solution(s)".format(**ws)
+    )
+    if metrics["buckets"]:
+        lines.append("buckets:")
+        for label, b in sorted(metrics["buckets"].items()):
+            occ = ("%.3f" % b["occupancy"]
+                   if b["occupancy"] is not None else "n/a")
+            lines.append(
+                f"  {label}: {b['submitted']} req, {b['batches']} batch(es) "
+                f"@ lanes {b['lane_counts']}, occupancy {occ}, "
+                f"{b['timeouts']} timeout(s), {b['compiles']} compile(s)"
+            )
+    return "\n".join(lines)

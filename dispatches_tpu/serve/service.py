@@ -1,0 +1,504 @@
+"""``SolveService``: a micro-batching solve layer over the batch kernels.
+
+The ROADMAP north star is request-serving scale, but the batch-native
+kernels (``solvers/pdlp_batch.py``, vmapped ``solvers/ipm.py``) only pay
+off when one caller already holds a full scenario slab.  This service is
+the aggregation layer in between: callers submit *individual* solve
+requests (``submit(...) -> SolveHandle``; ``solve_many`` for synchronous
+drivers), the service groups them into shape buckets by compiled-program
+fingerprint (``serve/bucket.py``), pads each batch to a small menu of
+power-of-two lane counts, and drains the queue through ONE jitted
+vmapped kernel per bucket — so each (bucket, lane-count) pair lowers
+once and replays forever (the PR-1 ``graft_jit``/``assert_no_recompiles``
+contract, observable via ``metrics()['compile_count']``).
+
+Dispatch policy
+---------------
+* a bucket flushes when it reaches ``max_batch`` pending requests;
+* any bucket whose OLDEST request has waited ``max_wait_ms`` flushes on
+  the next ``submit``/``poll`` (the service is synchronous and
+  single-threaded by design — determinism over threads; an async
+  front-end can call ``poll()`` from its own timer);
+* the total queue is bounded by ``max_queue``: when full, the bucket
+  holding the oldest pending request is flushed first (backpressure,
+  oldest-first) before the new request is accepted;
+* a request whose ``deadline_ms`` expired before its batch dispatched
+  completes with ``RequestStatus.TIMEOUT`` (never an exception) and is
+  dropped from the batch — expired lanes cannot poison live ones.
+
+Warm starts
+-----------
+IPM-path requests are warm-started from an in-memory LRU of previous
+solutions keyed by request fingerprint, reusing
+``utils/checkpoint.solution_x0`` (the ``warm_start_from`` layout guard)
+to reconstitute ``x0`` — a changed model layout yields a cold start,
+never a bad vector.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.serve.bucket import (
+    freeze_options,
+    pad_lanes,
+    params_signature,
+    request_fingerprint,
+)
+from dispatches_tpu.serve.metrics import BucketStats, LatencyWindow, format_stats
+from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
+from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+
+__all__ = [
+    "RequestStatus",
+    "ServeOptions",
+    "ServeResult",
+    "SolveHandle",
+    "SolveService",
+    "get_default_service",
+    "set_default_service",
+]
+
+_PDLP_FIELDS = set(PDLPOptions.__dataclass_fields__)
+_IPM_FIELDS = set(IPMOptions._fields)
+
+
+class RequestStatus:
+    QUEUED = "QUEUED"
+    DONE = "DONE"
+    TIMEOUT = "TIMEOUT"
+
+
+@dataclass(frozen=True)
+class ServeOptions:
+    """Dispatch-policy knobs (env-overridable, see ``from_env``)."""
+
+    max_batch: int = 64        # flush threshold == max lanes per dispatch
+    max_wait_ms: float = 10.0  # oldest-request age that forces a flush
+    max_queue: int = 1024      # total pending bound (backpressure)
+    warm_start: bool = True    # feed cached solutions back as x0 (IPM)
+    warm_cache_size: int = 512
+    latency_window: int = 4096
+    #: optional 1-D device mesh (``parallel.sharding.scenario_mesh``):
+    #: batches whose lane count divides the mesh are dispatched with the
+    #: lane axis sharded over the devices (computation follows data, as
+    #: in ``scenario_sharded_solver``); smaller batches stay replicated.
+    #: Lane counts map deterministically to one sharding each, so the
+    #: one-program-per-(bucket, lane-count) accounting is unchanged.
+    mesh: Optional[object] = None
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeOptions":
+        """Defaults with ``DISPATCHES_TPU_SERVE_*`` env overrides applied
+        (flags registered in ``analysis.flags``; GL006)."""
+        env: Dict = {}
+        raw = os.environ.get(flag_name("SERVE_MAX_BATCH"), "")
+        if raw:
+            env["max_batch"] = int(raw)
+        raw = os.environ.get(flag_name("SERVE_MAX_WAIT_MS"), "")
+        if raw:
+            env["max_wait_ms"] = float(raw)
+        raw = os.environ.get(flag_name("SERVE_MAX_QUEUE"), "")
+        if raw:
+            env["max_queue"] = int(raw)
+        env.update(overrides)
+        return cls(**env)
+
+
+class ServeResult(NamedTuple):
+    status: str
+    result: Optional[object]   # lane-sliced LPResult/IPMResult (DONE only)
+    obj: Optional[float]       # scalar objective (DONE only)
+    latency_ms: float
+
+
+class SolveHandle:
+    """Future-style handle for one submitted request.  ``result()``
+    blocks by draining the owning bucket (synchronous service)."""
+
+    __slots__ = ("_service", "_bucket", "params", "x0", "submitted_at",
+                 "deadline_at", "warm_key", "_result")
+
+    def __init__(self, service, bucket, params, submitted_at, deadline_at):
+        self._service = service
+        self._bucket = bucket
+        self.params = params
+        self.x0 = None
+        self.submitted_at = submitted_at
+        self.deadline_at = deadline_at
+        self.warm_key = None
+        self._result = None
+
+    @property
+    def bucket_label(self) -> str:
+        return self._bucket.stats.label
+
+    @property
+    def status(self) -> str:
+        return RequestStatus.QUEUED if self._result is None else self._result.status
+
+    def done(self) -> bool:
+        return self._result is not None
+
+    def result(self) -> ServeResult:
+        while self._result is None:
+            if self._service._flush_bucket(self._bucket) == 0:
+                raise RuntimeError(
+                    "request is neither pending nor completed — was the "
+                    "service reset while this handle was outstanding?"
+                )
+        return self._result
+
+    def _complete(self, serve_result: ServeResult) -> None:
+        self._result = serve_result
+
+
+class _WarmStartCache:
+    """In-memory counterpart of ``utils/checkpoint.warm_start_from``:
+    holds the UNRAVELED physical solution dict per request fingerprint
+    and reconstitutes ``x0`` through ``checkpoint.solution_x0``, so the
+    same layout guard applies (a changed model yields None — a cold
+    start — never a mis-shaped vector)."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._d: "OrderedDict[object, dict]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def get(self, key, nlp) -> Optional[np.ndarray]:
+        from dispatches_tpu.utils.checkpoint import solution_x0
+
+        sol = self._d.get(key)
+        if sol is None:
+            return None
+        self._d.move_to_end(key)
+        return solution_x0(sol, nlp)
+
+    def put(self, key, nlp, lane_result) -> None:
+        self._d[key] = nlp.unravel(lane_result.x)
+        self._d.move_to_end(key)
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+
+
+class _Bucket:
+    """One shape bucket: a resolved solver kind, its jitted vmapped
+    kernel (compile-counted via graft_jit), and the pending queue."""
+
+    def __init__(self, nlp, solver: str, options: Dict, label: str):
+        self.nlp = nlp
+        self.pending: "deque[SolveHandle]" = deque()
+        kind = solver.lower()
+        opts = dict(options or {})
+        base = opts.pop("base_solver", None)
+        if base is not None:
+            # caller-built per-scenario solver (e.g. the bidder's
+            # already-autoscaled IPM); caller declares the kind
+            kind = "ipm" if kind in ("auto", "ipm", "ipopt") else "pdlp"
+        elif kind in ("auto", "pdlp", "cbc"):
+            lp_kw = {k: v for k, v in opts.items() if k in _PDLP_FIELDS}
+            lp_kw.setdefault("tol", 1e-8)
+            lp_kw.setdefault("dtype", "float64")
+            try:
+                base = make_pdlp_solver(nlp, PDLPOptions(**lp_kw))
+                kind = "pdlp"
+            except ValueError:
+                if kind != "auto":
+                    raise
+                kind = "ipm"
+        elif kind not in ("ipm", "ipopt"):
+            raise ValueError(
+                f"unknown serve solver kind {solver!r}; expected "
+                "'auto', 'pdlp', 'cbc', 'ipm' or 'ipopt'"
+            )
+        if base is None:  # ipm / ipopt / auto-fallback
+            ipm_kw = {k: v for k, v in opts.items() if k in _IPM_FIELDS}
+            base = make_ipm_solver(
+                nlp, IPMOptions(**ipm_kw) if ipm_kw else IPMOptions()
+            )
+            kind = "ipm"
+        self.kind = kind
+        self.stats = BucketStats(label)
+        if kind == "ipm":
+            # x0 always passed: one compiled signature per lane count
+            # whether lanes are cold (default x0) or warm-started
+            self.default_x0 = np.asarray(nlp.x0) * np.asarray(nlp.var_scale)
+            self.run = graft_jit(jax.vmap(base, in_axes=(0, 0)),
+                                 label=f"serve.{label}")
+        else:
+            self.default_x0 = None
+            self.run = graft_jit(jax.vmap(base), label=f"serve.{label}")
+
+    @property
+    def compiles(self) -> int:
+        return self.run._graft_counter.count
+
+
+class SolveService:
+    """Micro-batching solve service over the batched kernels.
+
+    ``clock`` is injectable (defaults to ``time.monotonic``) so tests
+    drive the max-wait / deadline policy deterministically.
+    """
+
+    def __init__(self, options: Optional[ServeOptions] = None, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.options = options if options is not None else ServeOptions.from_env()
+        self._clock = clock
+        self._buckets: Dict = {}
+        self._latency = LatencyWindow(self.options.latency_window)
+        self._warm = _WarmStartCache(self.options.warm_cache_size)
+        self._warm_hits = 0
+        self._warm_misses = 0
+        self._submitted = 0
+        self._solved = 0
+        self._timeouts = 0
+        self._flushes = 0
+
+    # -- bucket resolution -------------------------------------------------
+
+    def _bucket_for(self, nlp, solver: str, options: Dict, params,
+                    base_solver) -> _Bucket:
+        opts_key = freeze_options(
+            {k: v for k, v in (options or {}).items()})
+        key = (id(nlp), solver.lower(), opts_key, params_signature(params),
+               id(base_solver) if base_solver is not None else None)
+        bucket = self._buckets.get(key)
+        # id() keys can collide after GC reuses an address (the factory
+        # cache bug class); the bucket pins the nlp strongly, so an
+        # identity mismatch can only mean a genuinely different object
+        if bucket is not None and bucket.nlp is not nlp:
+            bucket = None
+        if bucket is None:
+            label = f"{solver.lower()}#{len(self._buckets)}"
+            opts = dict(options or {})
+            if base_solver is not None:
+                opts["base_solver"] = base_solver
+            bucket = _Bucket(nlp, solver, opts, label)
+            self._buckets[key] = bucket
+        return bucket
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, nlp, params=None, x0=None, *, solver: str = "auto",
+               options: Optional[Dict] = None,
+               deadline_ms: Optional[float] = None,
+               warm_key=None, base_solver=None) -> SolveHandle:
+        """Queue one solve request and return its handle.
+
+        ``params`` follows ``nlp.default_params()`` structure (defaults
+        used when None).  ``x0`` (physical, IPM path only) overrides the
+        warm-start cache.  ``deadline_ms`` is relative to submission;
+        an expired request completes with ``TIMEOUT`` status instead of
+        raising.  ``base_solver`` lets a caller supply its own
+        per-scenario ``solve(params, x0)`` callable (bucketed by
+        identity) instead of having the service build one.
+        """
+        now = self._clock()
+        self.poll(now)
+        params = nlp.default_params() if params is None else params
+        bucket = self._bucket_for(nlp, solver, options, params, base_solver)
+        while self._queue_depth() >= self.options.max_queue:
+            if self._flush_oldest() == 0:
+                break  # nothing pending anywhere (max_queue == 0 edge)
+        deadline_at = None if deadline_ms is None else now + deadline_ms / 1e3
+        handle = SolveHandle(self, bucket, params, now, deadline_at)
+        if bucket.kind == "ipm":
+            handle.warm_key = (warm_key if warm_key is not None
+                               else (bucket.stats.label,
+                                     request_fingerprint(params)))
+            if x0 is None and self.options.warm_start:
+                x0 = self._warm.get(handle.warm_key, nlp)
+                if x0 is None:
+                    self._warm_misses += 1
+                else:
+                    self._warm_hits += 1
+            handle.x0 = np.asarray(
+                bucket.default_x0 if x0 is None else x0)
+        bucket.pending.append(handle)
+        bucket.stats.submitted += 1
+        self._submitted += 1
+        if len(bucket.pending) >= self.options.max_batch:
+            self._flush_bucket(bucket)
+        return handle
+
+    def solve(self, nlp, params=None, x0=None, **submit_kw):
+        """Blocking single solve through the service; returns the raw
+        lane result (LPResult/IPMResult), so reference-style drivers are
+        oblivious to the batching layer."""
+        sr = self.submit(nlp, params, x0, **submit_kw).result()
+        if sr.status != RequestStatus.DONE:
+            raise RuntimeError(f"serve solve finished with status {sr.status}")
+        return sr.result
+
+    def solve_many(self, nlp, params_list: Sequence, x0s=None,
+                   **submit_kw) -> List[ServeResult]:
+        """Submit a list of requests for one nlp, drain, and return
+        results in submission order (the synchronous-driver entry)."""
+        handles = [
+            self.submit(nlp, p, None if x0s is None else x0s[i], **submit_kw)
+            for i, p in enumerate(params_list)
+        ]
+        self.flush_all()
+        return [h.result() for h in handles]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> int:
+        """Flush every bucket whose oldest request exceeded max_wait_ms;
+        returns the number of requests dispatched or timed out."""
+        now = self._clock() if now is None else now
+        wait_s = self.options.max_wait_ms / 1e3
+        n = 0
+        for bucket in list(self._buckets.values()):
+            while bucket.pending and (
+                    now - bucket.pending[0].submitted_at >= wait_s):
+                n += self._flush_bucket(bucket)
+        return n
+
+    def flush_all(self) -> int:
+        """Drain every pending request; returns how many were handled."""
+        n = 0
+        for bucket in list(self._buckets.values()):
+            while bucket.pending:
+                n += self._flush_bucket(bucket)
+        return n
+
+    def _queue_depth(self) -> int:
+        return sum(len(b.pending) for b in self._buckets.values())
+
+    def _flush_oldest(self) -> int:
+        """Backpressure relief: flush the bucket holding the oldest
+        pending request (oldest-first policy)."""
+        oldest = None
+        for bucket in self._buckets.values():
+            if bucket.pending and (
+                    oldest is None
+                    or bucket.pending[0].submitted_at
+                    < oldest.pending[0].submitted_at):
+                oldest = bucket
+        return 0 if oldest is None else self._flush_bucket(oldest)
+
+    def _flush_bucket(self, bucket: _Bucket) -> int:
+        """Dispatch up to max_batch requests from one bucket; returns
+        the number of requests completed (solved or timed out)."""
+        n = min(len(bucket.pending), self.options.max_batch)
+        if n == 0:
+            return 0
+        self._flushes += 1
+        requests = [bucket.pending.popleft() for _ in range(n)]
+        now = self._clock()
+        live: List[SolveHandle] = []
+        for r in requests:
+            if r.deadline_at is not None and now >= r.deadline_at:
+                r._complete(ServeResult(
+                    RequestStatus.TIMEOUT, None, None,
+                    (now - r.submitted_at) * 1e3))
+                bucket.stats.timeouts += 1
+                self._timeouts += 1
+            else:
+                live.append(r)
+        if not live:
+            return n
+        lanes = pad_lanes(len(live), self.options.max_batch)
+        pad = lanes - len(live)
+        plist = [r.params for r in live] + [live[-1].params] * pad
+        batched = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *plist)
+        if bucket.kind == "ipm":
+            x0_stack = jnp.stack(
+                [jnp.asarray(v) for v in
+                 [r.x0 for r in live] + [live[-1].x0] * pad])
+        mesh = self.options.mesh
+        if mesh is not None and lanes % mesh.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            shard = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+            batched = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, shard), batched)
+            if bucket.kind == "ipm":
+                x0_stack = jax.device_put(x0_stack, shard)
+        if bucket.kind == "ipm":
+            res = bucket.run(batched, x0_stack)
+        else:
+            res = bucket.run(batched)
+        res = jax.block_until_ready(res)
+        bucket.stats.record_batch(len(live), lanes)
+        end = self._clock()
+        objs = np.asarray(res.obj)
+        for i, r in enumerate(live):
+            lane = jax.tree_util.tree_map(lambda a, _i=i: a[_i], res)
+            latency = (end - r.submitted_at) * 1e3
+            r._complete(ServeResult(
+                RequestStatus.DONE, lane, float(objs[i]), latency))
+            self._latency.record(latency)
+            bucket.stats.solved += 1
+            self._solved += 1
+            if bucket.kind == "ipm" and self.options.warm_start:
+                self._warm.put(r.warm_key, bucket.nlp, lane)
+        return n
+
+    # -- telemetry ---------------------------------------------------------
+
+    def metrics(self) -> Dict:
+        """Plain-dict service telemetry (see docs/serve.md)."""
+        buckets = {b.stats.label: b.stats.as_dict(b.compiles)
+                   for b in self._buckets.values()}
+        live = sum(b.stats.live_dispatched for b in self._buckets.values())
+        lanes = sum(b.stats.lanes_dispatched for b in self._buckets.values())
+        return {
+            "submitted": self._submitted,
+            "solved": self._solved,
+            "timeouts": self._timeouts,
+            "queue_depth": self._queue_depth(),
+            "flushes": self._flushes,
+            "batches": sum(b.stats.batches for b in self._buckets.values()),
+            "occupancy_mean": (live / lanes) if lanes else None,
+            # traces of the per-bucket jitted kernels == number of
+            # (bucket, padded-lane-count) programs lowered so far
+            "compile_count": sum(b.compiles for b in self._buckets.values()),
+            "programs": sum(len(b.stats.lane_counts)
+                            for b in self._buckets.values()),
+            "latency": self._latency.summary(),
+            "warm_start": {"hits": self._warm_hits,
+                           "misses": self._warm_misses,
+                           "size": len(self._warm)},
+            "buckets": buckets,
+        }
+
+    def format_stats(self) -> str:
+        """The ``--stats`` text report (``serve/__main__.py``)."""
+        return format_stats(self.metrics())
+
+
+_default_service: Optional[SolveService] = None
+
+
+def get_default_service() -> SolveService:
+    """The process-wide shared service (``SolverFactory('serve')`` and
+    grid drivers route here unless handed an explicit instance)."""
+    global _default_service
+    if _default_service is None:
+        _default_service = SolveService()
+    return _default_service
+
+
+def set_default_service(service: Optional[SolveService]) -> Optional[SolveService]:
+    """Swap the shared service (tests / custom policies); returns the
+    previous one."""
+    global _default_service
+    prev = _default_service
+    _default_service = service
+    return prev

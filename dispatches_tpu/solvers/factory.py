@@ -6,15 +6,51 @@ Here the factory hands out solver objects with a ``solve(nlp, params=...)``
 method so drivers read like the reference's, while the execution path is
 the batched JAX solvers: the reference's CBC (LP) maps to the first-order
 PDLP kernel with an IPM fallback for non-affine models, and IPOPT (NLP)
-maps to the interior-point kernel.
+maps to the interior-point kernel.  ``SolverFactory("serve")`` routes the
+same call shape through the shared micro-batching ``SolveService``
+(``dispatches_tpu.serve``), so independent drivers aggregate into one
+batched program per shape bucket.
 """
 
 from __future__ import annotations
 
-import jax
+import weakref
 
+from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+
+
+class NLPKeyedCache:
+    """``(nlp, frozen-options) -> value`` cache that is safe against
+    ``id()`` reuse.
+
+    A bare ``(id(nlp), opts)`` key can go stale: once an nlp is
+    garbage-collected, a NEW CompiledNLP can be allocated at the same
+    address and silently inherit the old compiled solver — wrong shapes
+    or wrong model, no error.  Each entry therefore pins a weakref to
+    its nlp and a hit requires the referent to still BE the lookup
+    object; a dead or swapped referent is a miss (and the stale entry is
+    dropped)."""
+
+    def __init__(self):
+        self._entries = {}
+
+    def get(self, nlp, key):
+        entry = self._entries.get((id(nlp), key))
+        if entry is None:
+            return None
+        ref, value = entry
+        if ref() is not nlp:  # address reuse after GC: stale entry
+            del self._entries[(id(nlp), key)]
+            return None
+        return value
+
+    def set(self, nlp, key, value) -> None:
+        self._entries[(id(nlp), key)] = (weakref.ref(nlp), value)
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
 
 class _IPMSolver:
@@ -22,13 +58,23 @@ class _IPMSolver:
 
     def __init__(self, **options):
         self.options = options
+        # (nlp, frozen options) -> jitted solver: reference-style
+        # drivers call solve() in a loop and must not pay autoscale
+        # probing + XLA lowering per call (the same contract
+        # _PDLPSolver already kept)
+        self._cache = NLPKeyedCache()
 
     def solve(self, nlp, params=None, x0=None, tee: bool = False, **opt_overrides):
         opts = dict(self.options)
         opts.update(opt_overrides)
-        ipm_opts = IPMOptions(**opts) if opts else IPMOptions()
         params = nlp.default_params() if params is None else params
-        solver = jax.jit(make_ipm_solver(nlp, ipm_opts))
+        key = tuple(sorted(opts.items()))
+        solver = self._cache.get(nlp, key)
+        if solver is None:
+            ipm_opts = IPMOptions(**opts) if opts else IPMOptions()
+            solver = graft_jit(make_ipm_solver(nlp, ipm_opts),
+                               label="factory.ipm")
+            self._cache.set(nlp, key, solver)
         res = solver(params) if x0 is None else solver(params, x0)
         if tee:
             print(
@@ -54,11 +100,11 @@ class _PDLPSolver:
 
     def __init__(self, **options):
         self.options = options
-        # (id(nlp), frozen options) -> ("pdlp"|"ipm", jitted solver):
+        # (nlp, frozen options) -> ("pdlp"|"ipm", jitted solver):
         # the reference's per-scenario SolverFactory("cbc").solve loop
         # must not pay LP extraction + XLA compile per call, on either
         # the affine or the fallback path
-        self._cache = {}
+        self._cache = NLPKeyedCache()
 
     def solve(self, nlp, params=None, x0=None, tee: bool = False, **opt_overrides):
         """NOTE: ``x0`` is honored only on the IPM fallback path — PDHG
@@ -67,8 +113,8 @@ class _PDLPSolver:
         opts = dict(self.options)
         opts.update(opt_overrides)
         params = nlp.default_params() if params is None else params
-        key = (id(nlp), tuple(sorted(opts.items())))
-        kind_solver = self._cache.get(key)
+        key = tuple(sorted(opts.items()))
+        kind_solver = self._cache.get(nlp, key)
         if kind_solver is None:
             lp_kw = {k: v for k, v in opts.items() if k in self._PDLP_FIELDS}
             lp_kw.setdefault("tol", 1e-8)
@@ -76,7 +122,8 @@ class _PDLPSolver:
             try:
                 kind_solver = (
                     "pdlp",
-                    jax.jit(make_pdlp_solver(nlp, PDLPOptions(**lp_kw))),
+                    graft_jit(make_pdlp_solver(nlp, PDLPOptions(**lp_kw)),
+                              label="factory.pdlp"),
                 )
             except ValueError:  # not affine: hand off to the NLP kernel
                 if tee:
@@ -86,13 +133,14 @@ class _PDLPSolver:
                 }
                 kind_solver = (
                     "ipm",
-                    jax.jit(
+                    graft_jit(
                         make_ipm_solver(
                             nlp, IPMOptions(**ipm_kw) if ipm_kw else IPMOptions()
-                        )
+                        ),
+                        label="factory.pdlp_ipm_fallback",
                     ),
                 )
-            self._cache[key] = kind_solver
+            self._cache.set(nlp, key, kind_solver)
         kind, solver = kind_solver
         if kind == "ipm":
             res = solver(params) if x0 is None else solver(params, x0)
@@ -117,9 +165,49 @@ class _PDLPSolver:
         return res
 
 
+class _ServeSolver:
+    """Route reference-style ``solve(nlp, params=...)`` calls through
+    the shared micro-batching :class:`~dispatches_tpu.serve.SolveService`
+    (``dispatches_tpu/serve/``): independent callers holding the same
+    model aggregate into one compiled batch per shape bucket.
+
+    ``SolverFactory("serve")`` uses the process-wide default service;
+    pass ``service=`` for an isolated one, and ``solver=`` to pin the
+    kernel kind ("pdlp"/"ipm"; default "auto")."""
+
+    name = "serve"
+
+    def __init__(self, service=None, solver: str = "auto", **options):
+        if service is None:
+            from dispatches_tpu.serve import get_default_service
+
+            service = get_default_service()
+        self.service = service
+        self.kind = solver
+        self.options = options
+
+    def solve(self, nlp, params=None, x0=None, tee: bool = False,
+              **opt_overrides):
+        opts = dict(self.options)
+        opts.update(opt_overrides)
+        handle = self.service.submit(
+            nlp, params, x0, solver=self.kind, options=opts or None)
+        sr = handle.result()
+        if sr.status != "DONE":
+            raise RuntimeError(
+                f"serve solve finished with status {sr.status}")
+        if tee:
+            print(
+                f"[dispatches_tpu.serve] bucket={handle.bucket_label} "
+                f"latency_ms={sr.latency_ms:.2f} obj={sr.obj:.8g}"
+            )
+        return sr.result
+
+
 _REGISTRY = {
     "ipm": _IPMSolver,
     "pdlp": _PDLPSolver,
+    "serve": _ServeSolver,
     # aliases so reference-style driver code ports verbatim: the
     # reference's LP workhorse (CBC) maps to the first-order LP kernel,
     # its NLP workhorse (IPOPT) to the interior-point kernel.

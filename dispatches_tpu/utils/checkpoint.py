@@ -78,21 +78,29 @@ def save_solution(path, nlp, res) -> Path:
     return save_state(path, tree)
 
 
-def warm_start_from(path, nlp) -> Optional[np.ndarray]:
-    """Physical x0 vector for ``solve(params, x0=...)`` from a solution
-    checkpoint, or None when the layout no longer matches (model
-    changed since the checkpoint — the init-once-replicate guard)."""
-    try:
-        tree = load_state(path)
-    except FileNotFoundError:
-        return None
-    sol = tree.get("solution", {})
+def solution_x0(sol: Dict, nlp) -> Optional[np.ndarray]:
+    """Physical x0 vector assembled from an unraveled solution dict
+    (``nlp.unravel`` layout), or None when the layout no longer matches
+    the model (the init-once-replicate guard).  Shared by the on-disk
+    :func:`warm_start_from` path and the solve service's in-memory
+    warm-start cache (``serve/service.py``)."""
     parts = []
     for name in nlp.free_names:
         a, b, shape = nlp._slices[name]
         if name not in sol or tuple(np.shape(sol[name])) != tuple(shape):
             return None
-        parts.append(np.ravel(sol[name]))
+        parts.append(np.ravel(np.asarray(sol[name])))
     if not parts:
         return None
     return np.concatenate(parts)
+
+
+def warm_start_from(path, nlp) -> Optional[np.ndarray]:
+    """Physical x0 vector for ``solve(params, x0=...)`` from a solution
+    checkpoint, or None when the layout no longer matches (model
+    changed since the checkpoint) or the file is missing."""
+    try:
+        tree = load_state(path)
+    except FileNotFoundError:
+        return None
+    return solution_x0(tree.get("solution", {}), nlp)

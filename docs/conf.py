@@ -18,6 +18,15 @@ extensions = [
 
 templates_path = []
 exclude_patterns = ["_build"]
+
+# markdown pages (analysis.md, serve.md) need myst; keep the rst-only
+# build working where it is not installed
+try:
+    import myst_parser  # noqa: F401
+
+    extensions.append("myst_parser")
+except ImportError:
+    exclude_patterns.append("*.md")
 html_theme = "alabaster"
 
 # heavy/optional imports that autodoc should not require at build time

@@ -47,6 +47,21 @@ def test_state_roundtrip(tmp_path):
     assert float(loaded["nested"]["c"]) == 2.5
 
 
+def test_state_roundtrip_preserves_dtype(tmp_path):
+    tree = {
+        "f32": np.linspace(0, 1, 5, dtype=np.float32),
+        "i32": np.arange(4, dtype=np.int32),
+        "nested": {"f64": np.ones((2, 2))},
+    }
+    save_state(tmp_path / "dt", tree)
+    loaded = load_state(tmp_path / "dt")
+    assert loaded["f32"].dtype == np.float32
+    assert loaded["i32"].dtype == np.int32
+    assert loaded["nested"]["f64"].dtype == np.float64
+    np.testing.assert_array_equal(loaded["f32"], tree["f32"])
+    np.testing.assert_array_equal(loaded["i32"], tree["i32"])
+
+
 def test_solution_checkpoint_and_warm_start(tmp_path):
     nlp = _model()
     res = solve_nlp(nlp, options=IPMOptions(max_iter=100))
@@ -55,9 +70,13 @@ def test_solution_checkpoint_and_warm_start(tmp_path):
 
     x0 = warm_start_from(tmp_path / "sol", nlp)
     assert x0 is not None and x0.shape == (nlp.n,)
-    # warm-started resolve reaches the same objective
+    assert x0.dtype == np.float64
+    # warm-started resolve reaches the same objective — and the point
+    # of the checkpoint: strictly fewer iterations than the cold start
     res2 = solve_nlp(nlp, x0=x0, options=IPMOptions(max_iter=100))
+    assert bool(res2.converged)
     assert float(res2.obj) == pytest.approx(float(res.obj), rel=1e-8)
+    assert int(res2.iterations) < int(res.iterations)
 
     # layout mismatch -> None (model changed since checkpoint)
     other = _model(T=10)

@@ -1,0 +1,98 @@
+"""SolverFactory solve-path caching: repeated ``solve()`` calls must
+not re-lower (the reference's per-scenario SolverFactory loop), and the
+cache key must survive ``id()`` reuse after garbage collection."""
+
+import gc
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.analysis.runtime import assert_no_recompiles
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.solvers.factory import NLPKeyedCache, SolverFactory
+
+
+def _model(T=8):
+    fs = Flowsheet(horizon=T)
+    fs.add_var("charge", lb=0, ub=2.0)
+    fs.add_var("discharge", lb=0, ub=2.0)
+    fs.add_var("soc", lb=0, ub=8.0)
+    fs.add_param("price", np.sin(np.arange(T)) * 20 + 30)
+    fs.add_eq(
+        "soc_evolution",
+        lambda v, p: v["soc"] - tshift(v["soc"], jnp.asarray(0.0))
+        - 0.9 * v["charge"] + v["discharge"] / 0.9,
+    )
+    return fs.compile(
+        objective=lambda v, p: jnp.sum(
+            p["price"] * (v["discharge"] - v["charge"])),
+        sense="max",
+    )
+
+
+def _priced(nlp, price):
+    params = nlp.default_params()
+    params["p"]["price"] = np.asarray(price, float)
+    return params
+
+
+def test_ipm_factory_solves_without_recompiling():
+    """A reference-style loop over param values pays ONE lowering: the
+    jitted solver is cached per (nlp, options), like the PDLP path."""
+    nlp = _model()
+    factory = SolverFactory("ipm", max_iter=120)
+    rng = np.random.default_rng(0)
+    first = factory.solve(nlp, _priced(nlp, 30 + 10 * rng.standard_normal(8)))
+    assert bool(first.converged)
+    with assert_no_recompiles():
+        for _ in range(4):
+            res = factory.solve(
+                nlp, _priced(nlp, 30 + 10 * rng.standard_normal(8)))
+            assert bool(res.converged)
+
+
+def test_factory_cache_two_sequential_nlps():
+    """Construct, solve, and drop NLPs in sequence through ONE factory:
+    if the cache keyed on a recycled ``id()``, the second model could
+    silently inherit the first model's compiled solver (wrong shapes or
+    wrong answers).  Shapes differ here so a stale hit cannot pass."""
+    factory = SolverFactory("ipm", max_iter=120)
+    for T in (8, 10):
+        nlp = _model(T)
+        res = factory.solve(nlp)
+        assert np.asarray(res.x).shape == (nlp.n,)
+        assert bool(res.converged)
+        del nlp
+        gc.collect()
+
+
+def test_nlp_keyed_cache_rejects_stale_id_entry():
+    """The guard itself: an entry whose weakref no longer points at the
+    lookup object (address reuse after GC) must miss and be dropped."""
+
+    class Obj:
+        pass
+
+    cache = NLPKeyedCache()
+    a, b = Obj(), Obj()
+    cache.set(a, "k", "value-for-a")
+    assert cache.get(a, "k") == "value-for-a"
+    assert cache.get(b, "k") is None  # different object, different key
+
+    # simulate id(b) landing on a's old address: move a's entry onto
+    # b's key, then drop a — exactly what address reuse produces
+    cache._entries[(id(b), "k")] = cache._entries.pop((id(a), "k"))
+    del a
+    gc.collect()
+    assert cache.get(b, "k") is None  # stale entry refused...
+    assert len(cache) == 0            # ...and evicted
+
+    cache.set(b, "k", "value-for-b")  # fresh entry works again
+    assert cache.get(b, "k") == "value-for-b"
+
+
+def test_factory_unknown_solver():
+    with pytest.raises(ValueError, match="unknown solver"):
+        SolverFactory("gurobi")
