@@ -35,6 +35,12 @@ REGISTERED_FLAGS = {
     "request before a forced flush (serve.ServeOptions.from_env)",
     "SERVE_MAX_QUEUE": "solve-service total pending-request bound; a "
     "full queue flushes oldest-first (serve.ServeOptions.from_env)",
+    "SWEEP_CHUNK": "sweep-engine points per chunk == checkpoint/resume "
+    "granularity (sweep.SweepOptions.from_env)",
+    "SWEEP_MAX_RETRIES": "sweep-engine point-wise retry budget before a "
+    "non-finite result is quarantined (sweep.SweepOptions.from_env)",
+    "SWEEP_RESULT_DIR": "sweep-engine default ResultStore directory "
+    "(sweep.SweepOptions.from_env)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

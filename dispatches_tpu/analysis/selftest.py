@@ -114,11 +114,13 @@ CORPUS: Dict[str, Dict[str, str]] = {
             turbo = os.environ.get("DISPATCHES_TPU_TURBO")
             if "DISPATCHES_TPU_LUDICROUS" in os.environ:
                 speed = os.environ["DISPATCHES_TPU_LUDICROUS"]
+            chunk = os.environ.get("DISPATCHES_TPU_SWEEP_TURBO_CHUNK")
         """,
         "good": """
             import os
 
             slow = os.environ.get("DISPATCHES_TPU_SLOW")
+            chunk = os.environ.get("DISPATCHES_TPU_SWEEP_CHUNK")
         """,
     },
 }

@@ -1,0 +1,31 @@
+"""Design-space sweep engine: declarative specs, sharded batched
+execution, chunk-checkpointed fault tolerance, and the sweep->surrogate
+handoff (see docs/sweep.md)."""
+
+from dispatches_tpu.sweep.engine import SweepOptions, run_sweep
+from dispatches_tpu.sweep.spec import Axis, SweepSpec, grid, lhs, synhist
+from dispatches_tpu.sweep.store import (
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    STATUS_RETRIED,
+    ResultStore,
+    format_report,
+)
+from dispatches_tpu.sweep.surrogate import SweepData, train_revenue_surrogate
+
+__all__ = [
+    "Axis",
+    "ResultStore",
+    "STATUS_OK",
+    "STATUS_QUARANTINED",
+    "STATUS_RETRIED",
+    "SweepData",
+    "SweepOptions",
+    "SweepSpec",
+    "format_report",
+    "grid",
+    "lhs",
+    "run_sweep",
+    "synhist",
+    "train_revenue_surrogate",
+]

@@ -1,0 +1,318 @@
+"""Fault-tolerant sharded execution of :class:`~.spec.SweepSpec` sweeps.
+
+This is the managed-workload layer the reference never had: where
+DISPATCHES runs one solver subprocess per design point from shell
+loops, here the whole sweep is planned into shape-stable chunks sized
+to the serve layer's power-of-two lane menu (``serve.bucket.pad_lanes``
+— so the batched kernel lowers once per lane width and replays across
+chunks), executed through one of three interchangeable backends behind
+the same spec:
+
+* ``direct``  — one jitted ``jax.vmap`` of the batched IPM/PDLP kernel;
+* ``mesh``    — ``parallel.scenario_sharded_solver`` over a device mesh
+  (chunk lanes sharded across chips);
+* ``serve``   — per-point requests through a ``serve.SolveService``
+  (shared with live traffic, or a private warm-start-free instance).
+
+Robustness is first-class (MPAX and "Many Problems, One GPU" both treat
+the managed batch, not the single solve, as the unit of work):
+
+* every completed chunk is checkpointed atomically into a
+  :class:`~.store.ResultStore` before the next starts, so a killed
+  sweep loses at most one chunk of work;
+* ``resume=True`` skips completed chunks and — because chunk contents,
+  padding, and compiled programs are pure functions of the spec — the
+  finished store is bitwise identical to an uninterrupted run's;
+* a non-finite lane result is retried point-wise (``max_retries``) and
+  then QUARANTINED: recorded with status + NaN objective, never
+  poisoning the other lanes or the downstream surrogate labels.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Mapping, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.serve.bucket import pad_lanes, request_fingerprint
+from dispatches_tpu.sweep.spec import SweepSpec
+from dispatches_tpu.sweep.store import (
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    STATUS_RETRIED,
+    ResultStore,
+)
+
+__all__ = ["SweepOptions", "run_sweep"]
+
+
+@dataclass(frozen=True)
+class SweepOptions:
+    """Sweep-engine knobs (env-overridable, see ``from_env``)."""
+
+    chunk_size: int = 64       # points per chunk == checkpoint granularity
+    max_retries: int = 1       # point-wise retries before quarantine
+    result_dir: str = "sweep_store"  # default ResultStore directory
+    backend: str = "direct"    # "direct" | "mesh" | "serve"
+    #: "ipm"/"pdlp" (an "auto" serve bucket also works), or a prebuilt
+    #: jit/vmap-compatible ``callable(params) -> result`` with an
+    #: ``.obj`` field (the ``scenario_sharded_solver`` contract)
+    solver: Union[str, Callable] = "ipm"
+    solver_options: Optional[Mapping] = None  # IPMOptions/PDLPOptions fields
+    max_chunks: Optional[int] = None  # stop this run after N chunks
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SweepOptions":
+        """Defaults with ``DISPATCHES_TPU_SWEEP_*`` env overrides
+        applied (flags registered in ``analysis.flags``; GL006)."""
+        env: Dict = {}
+        raw = os.environ.get(flag_name("SWEEP_CHUNK"), "")
+        if raw:
+            env["chunk_size"] = int(raw)
+        raw = os.environ.get(flag_name("SWEEP_MAX_RETRIES"), "")
+        if raw:
+            env["max_retries"] = int(raw)
+        raw = os.environ.get(flag_name("SWEEP_RESULT_DIR"), "")
+        if raw:
+            env["result_dir"] = raw
+        env.update(overrides)
+        return cls(**env)
+
+
+def _resolve_solver(nlp, solver, solver_options):
+    """(base per-scenario solver, kind label) for the direct/mesh paths."""
+    if callable(solver):
+        return solver, "custom"
+    kind = str(solver).lower()
+    opts = dict(solver_options or {})
+    if kind in ("pdlp", "cbc"):
+        from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+
+        kw = {k: v for k, v in opts.items()
+              if k in PDLPOptions.__dataclass_fields__}
+        return make_pdlp_solver(nlp, PDLPOptions(**kw)), "pdlp"
+    if kind in ("ipm", "ipopt"):
+        from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
+
+        kw = {k: v for k, v in opts.items() if k in IPMOptions._fields}
+        return make_ipm_solver(
+            nlp, IPMOptions(**kw) if kw else IPMOptions()), "ipm"
+    raise ValueError(
+        f"unknown sweep solver {solver!r}; expected 'ipm', 'ipopt', "
+        "'pdlp', 'cbc', or a prebuilt callable")
+
+
+def _extract(res, n_live: int):
+    """(obj, converged, iterations) host arrays from a batched result
+    pytree (IPMResult / LPResult / any ``.obj``-bearing tuple),
+    padding stripped."""
+    obj = np.asarray(np.asarray(res.obj)[:n_live], dtype=np.float64)
+    conv = getattr(res, "converged", None)
+    conv = (np.asarray(conv)[:n_live].astype(bool) if conv is not None
+            else np.isfinite(obj))
+    it = getattr(res, "iterations", getattr(res, "iters", None))
+    if it is None:
+        iters = np.zeros(n_live, np.int64)
+    else:
+        it = np.asarray(it)
+        iters = (np.full(n_live, int(it)) if it.ndim == 0
+                 else it[:n_live]).astype(np.int64)
+    return obj, conv, iters
+
+
+def _pad_rows(values: Dict[str, np.ndarray], width: int):
+    """Repeat the last point to fill ``width`` lanes (shape-stable
+    dispatch; the padded lanes are masked out by the caller's slice)."""
+    out = {}
+    for k, v in values.items():
+        v = np.asarray(v)
+        if width > len(v):
+            v = np.concatenate([v, np.repeat(v[-1:], width - len(v), axis=0)])
+        out[k] = v
+    return out
+
+
+def run_sweep(nlp, spec: SweepSpec, *,
+              store_dir=None,
+              options: Optional[SweepOptions] = None,
+              resume: bool = False,
+              overwrite: bool = False,
+              base_params=None,
+              mesh=None,
+              service=None,
+              on_chunk: Optional[Callable[[int, int], None]] = None,
+              ) -> ResultStore:
+    """Plan + execute ``spec`` against ``nlp``; returns the (possibly
+    partial, if ``options.max_chunks`` capped the run) ``ResultStore``.
+
+    ``base_params`` overrides ``nlp.default_params()`` as the template
+    every point is written into (its content hash is pinned in the
+    manifest, so a resume with different base params is refused).
+    ``on_chunk(cid, n_chunks)`` fires after each chunk is durably
+    recorded — an exception from it (or a kill) loses nothing already
+    recorded.
+    """
+    opts = options if options is not None else SweepOptions.from_env()
+    if opts.chunk_size < 1:
+        raise ValueError("chunk_size must be >= 1")
+    defaults = nlp.default_params() if base_params is None else base_params
+    names_p = tuple(k for k in spec.swept_names if k in defaults["p"])
+    names_f = tuple(k for k in spec.swept_names if k in defaults["fixed"])
+    unknown = set(spec.swept_names) - set(names_p) - set(names_f)
+    if unknown:
+        raise KeyError(
+            f"spec sweeps unknown param/fixed names {sorted(unknown)}")
+
+    kind = opts.solver if isinstance(opts.solver, str) else "custom"
+    store = ResultStore.open_or_create(
+        store_dir if store_dir is not None else opts.result_dir,
+        spec, opts.chunk_size, resume=resume, overwrite=overwrite,
+        backend=opts.backend, solver=kind,
+        params_fingerprint=request_fingerprint(defaults))
+
+    solve_chunk = _make_backend(nlp, opts, defaults, names_p, names_f,
+                                mesh=mesh, service=service)
+
+    plan = store.chunk_plan()
+    ran = 0
+    for cid, start, stop in plan:
+        if cid in store.completed:
+            continue
+        if opts.max_chunks is not None and ran >= opts.max_chunks:
+            break
+        idxs = np.arange(start, stop)
+        values = spec.values_for(idxs)
+        n_live = len(idxs)
+        t0 = time.perf_counter()
+        obj, conv, iters = solve_chunk(values, n_live)
+        status = np.zeros(n_live, dtype=np.int8)
+        retries = np.zeros(n_live, dtype=np.int16)
+        for j in np.where(~np.isfinite(obj))[0]:
+            for attempt in range(1, opts.max_retries + 1):
+                single = {k: np.asarray(v)[j:j + 1]
+                          for k, v in values.items()}
+                o1, c1, i1 = solve_chunk(single, 1)
+                retries[j] = attempt
+                if np.isfinite(o1[0]):
+                    obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
+                    status[j] = STATUS_RETRIED
+                    break
+            else:
+                status[j] = STATUS_QUARANTINED
+                conv[j] = False
+        store.record_chunk(cid, {
+            "index": idxs.astype(np.int64),
+            "obj": obj,
+            "converged": conv,
+            "iterations": iters,
+            "status": status,
+            "retries": retries,
+            "inputs": spec.inputs_for(idxs),
+        }, time.perf_counter() - t0)
+        ran += 1
+        if on_chunk is not None:
+            on_chunk(cid, len(plan))
+    return store
+
+
+def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
+                  mesh=None, service=None):
+    """``solve_chunk(values, n_live) -> (obj, conv, iters)`` closure for
+    the configured backend."""
+    backend = opts.backend.lower()
+    if backend == "direct":
+        base, _ = _resolve_solver(nlp, opts.solver, opts.solver_options)
+        in_axes = {
+            "p": {k: (0 if k in names_p else None) for k in defaults["p"]},
+            "fixed": {k: (0 if k in names_f else None)
+                      for k in defaults["fixed"]},
+        }
+        vrun = jax.jit(jax.vmap(base, in_axes=(in_axes,)))
+
+        def solve_chunk(values, n_live):
+            width = pad_lanes(n_live, opts.chunk_size)
+            padded = _pad_rows(values, width)
+            p = {k: jnp.asarray(v) for k, v in defaults["p"].items()}
+            f = {k: jnp.asarray(v) for k, v in defaults["fixed"].items()}
+            for k, v in padded.items():
+                if k in p:
+                    p[k] = jnp.asarray(v)
+                else:
+                    f[k] = jnp.asarray(v)
+            return _extract(vrun({"p": p, "fixed": f}), n_live)
+
+        return solve_chunk
+
+    if backend == "mesh":
+        from dispatches_tpu.parallel import (
+            scenario_mesh,
+            scenario_sharded_solver,
+        )
+
+        if mesh is None:
+            mesh = scenario_mesh()
+        base, _ = _resolve_solver(nlp, opts.solver, opts.solver_options)
+        sharded = scenario_sharded_solver(
+            nlp, mesh, batched_keys=names_p, batched_fixed_keys=names_f,
+            solver=base, full_result=True)
+
+        def solve_chunk(values, n_live):
+            # the sharded solver pads to the mesh and strips internally
+            return _extract(sharded(values), n_live)
+
+        return solve_chunk
+
+    if backend == "serve":
+        if callable(opts.solver):
+            raise ValueError(
+                "the serve backend resolves its own kernels; pass "
+                "solver='ipm'/'pdlp' (or use backend='direct')")
+        if service is None:
+            from dispatches_tpu.serve import ServeOptions, SolveService
+
+            # private instance: no cross-request warm starts, so a
+            # resumed sweep replays identically to an uninterrupted one
+            service = SolveService(ServeOptions(
+                max_batch=opts.chunk_size, max_wait_ms=1e12,
+                max_queue=max(2 * opts.chunk_size, 2),
+                warm_start=False))
+        solver_kw = dict(solver=str(opts.solver),
+                         options=dict(opts.solver_options or {}))
+
+        def solve_chunk(values, n_live):
+            from dispatches_tpu.serve import RequestStatus
+
+            plist = []
+            for i in range(n_live):
+                p = dict(defaults["p"])
+                f = dict(defaults["fixed"])
+                for k, arr in values.items():
+                    if k in p:
+                        p[k] = np.asarray(arr)[i]
+                    else:
+                        f[k] = np.asarray(arr)[i]
+                plist.append({"p": p, "fixed": f})
+            rs = service.solve_many(nlp, plist, **solver_kw)
+            obj = np.full(n_live, np.nan)
+            conv = np.zeros(n_live, dtype=bool)
+            iters = np.zeros(n_live, dtype=np.int64)
+            for i, r in enumerate(rs):
+                if r.status != RequestStatus.DONE:
+                    continue
+                o, c, it = _extract(
+                    jax.tree_util.tree_map(lambda a: np.asarray(a)[None],
+                                           r.result), 1)
+                obj[i], conv[i], iters[i] = o[0], c[0], it[0]
+            return obj, conv, iters
+
+        return solve_chunk
+
+    raise ValueError(
+        f"unknown sweep backend {opts.backend!r}; expected 'direct', "
+        "'mesh', or 'serve'")

@@ -14,6 +14,7 @@ manifest for structure — loadable into warm starts without rebuilding.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Dict, Optional
 
@@ -42,13 +43,35 @@ def _unflatten(flat: Dict[str, np.ndarray]):
 
 
 def save_state(path, tree) -> Path:
-    """Serialize a (nested dict of) arrays — the ``to_json`` analog."""
+    """Serialize a (nested dict of) arrays — the ``to_json`` analog.
+
+    Writes are ATOMIC (tmp file + ``os.replace``): a process killed
+    mid-save can never leave a truncated/corrupt checkpoint behind — an
+    existing checkpoint at ``path`` survives intact, which is what the
+    sweep engine's chunk-level resume leans on.  The ``.npz`` is
+    replaced before the shape-manifest ``.json``; a kill between the
+    two leaves a fresh npz with a stale (but loadable) manifest, and
+    ``load_state`` reads only the npz.
+    """
     path = Path(path)
     flat = _flatten(tree)
-    np.savez(path.with_suffix(".npz"), **flat)
+    npz = path.with_suffix(".npz")
+    tmp = npz.with_name(npz.name + ".tmp")
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, npz)
+    finally:
+        tmp.unlink(missing_ok=True)
     manifest = {k: list(v.shape) for k, v in flat.items()}
-    path.with_suffix(".json").write_text(json.dumps(manifest))
-    return path.with_suffix(".npz")
+    jpath = path.with_suffix(".json")
+    jtmp = jpath.with_name(jpath.name + ".tmp")
+    try:
+        jtmp.write_text(json.dumps(manifest))
+        os.replace(jtmp, jpath)
+    finally:
+        jtmp.unlink(missing_ok=True)
+    return npz
 
 
 def load_state(path):

@@ -133,6 +133,21 @@ class TrainNNSurrogates:
         self.clustering_model = None
         self.num_clusters = None
 
+    @classmethod
+    def from_sweep(cls, store, filter_opt=False) -> "TrainNNSurrogates":
+        """Trainer wired to a finished ``sweep.ResultStore``: design
+        coordinates become the input table, sweep objectives the
+        revenue labels — replacing the reference's hand-rolled
+        rev-CSV/input-CSV pairing (``Train_NN_Surrogates.py:444-484``)
+        with the store's already-aligned arrays (quarantined points
+        pre-filtered).  Use with :meth:`train_NN_revenue`, or call
+        ``sweep.train_revenue_surrogate(store)`` for the one-liner."""
+        from dispatches_tpu.sweep.surrogate import SweepData
+
+        data = SweepData(store)
+        return cls(data, data_file=str(data.store.path),
+                   filter_opt=filter_opt)
+
     # -- clustering-model consumption (reference :160-205) ------------
 
     def _read_clustering_model(self, clustering_model_path):

@@ -83,3 +83,57 @@ def test_solution_checkpoint_and_warm_start(tmp_path):
     assert warm_start_from(tmp_path / "sol", other) is None
     # missing file -> None
     assert warm_start_from(tmp_path / "nope", nlp) is None
+
+
+def test_save_state_atomic_under_interrupt(tmp_path, monkeypatch):
+    """A save killed mid-write must never corrupt an existing
+    checkpoint: writes go to a tmp file and land via os.replace, so the
+    original npz stays loadable bit-for-bit (the sweep engine's
+    chunk-resume contract)."""
+    import numpy
+    from dispatches_tpu.utils import checkpoint as ckpt
+
+    tree = {"a": np.arange(8.0), "nested": {"b": np.ones((3, 2))}}
+    p = save_state(tmp_path / "ckpt", tree)
+    before = p.read_bytes()
+
+    real_savez = numpy.savez
+
+    def dying_savez(f, **arrays):
+        # write some real bytes, then die — a truncated partial file,
+        # exactly what a SIGKILL mid-save leaves behind
+        real_savez(f, **{k: v for k, v in list(arrays.items())[:1]})
+        raise RuntimeError("simulated kill mid-write")
+
+    monkeypatch.setattr(ckpt.np, "savez", dying_savez)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        save_state(tmp_path / "ckpt", {"a": np.zeros(8), "c": np.ones(2)})
+    monkeypatch.undo()
+
+    # the original checkpoint survives, bit-for-bit, and still loads
+    assert p.read_bytes() == before
+    loaded = load_state(tmp_path / "ckpt")
+    np.testing.assert_array_equal(loaded["a"], tree["a"])
+    np.testing.assert_array_equal(loaded["nested"]["b"], tree["nested"]["b"])
+    # no tmp litter left behind
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_save_state_atomic_fresh_path_no_partial(tmp_path, monkeypatch):
+    """An interrupted FIRST save leaves no npz at all (better missing
+    than truncated: load_state then raises FileNotFoundError instead of
+    a zipfile error deep inside numpy)."""
+    import numpy
+    from dispatches_tpu.utils import checkpoint as ckpt
+
+    def dying_savez(f, **arrays):
+        f.write(b"PK\x03\x04garbage")
+        raise RuntimeError("simulated kill mid-write")
+
+    monkeypatch.setattr(ckpt.np, "savez", dying_savez)
+    with pytest.raises(RuntimeError):
+        save_state(tmp_path / "fresh", {"a": np.zeros(4)})
+    monkeypatch.undo()
+    assert not (tmp_path / "fresh.npz").exists()
+    with pytest.raises(FileNotFoundError):
+        load_state(tmp_path / "fresh")

@@ -93,6 +93,50 @@ def test_sharded_production_wind_battery_matches_serial():
         assert objs[i] == pytest.approx(float(ref.obj), abs=1e-5)
 
 
+def test_sharded_solver_uneven_batch_matches_serial():
+    """Scenario counts that do NOT divide the device count: the solver
+    pads to a mesh multiple with masked (repeat-last) lanes and strips
+    the padding from results — callers never see the pad (regression
+    for the 366-day-on-8-devices case)."""
+    nlp = _storage_nlp()
+    mesh = scenario_mesh(8)
+    rng = np.random.default_rng(5)
+    solve = scenario_sharded_solver(nlp, mesh, batched_keys=("price",),
+                                    max_iter=60)
+
+    from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+    # one serial reference solver reused across points: same shapes ->
+    # one compile (keeps this parity check cheap in the tier-1 budget)
+    base = make_ipm_solver(nlp, IPMOptions(max_iter=60))
+
+    # 13 spills one device row, 11 underfills it deeper; both pad to
+    # the same 16-lane shape, so the second count replays the compile
+    for n_scen in (13, 11):
+        prices = rng.uniform(1.0, 10.0, (n_scen, 8))
+        objs = np.asarray(solve({"price": prices}))
+        assert objs.shape == (n_scen,)
+        for i in (0, n_scen - 1):
+            params = nlp.default_params()
+            params["p"]["price"] = prices[i]
+            ref = base(params)
+            assert objs[i] == pytest.approx(float(ref.obj), abs=1e-6)
+
+
+def test_sharded_solver_uneven_full_result_strips_padding():
+    """full_result=True must strip pad lanes from EVERY leaf of the
+    result pytree, not just the objective."""
+    nlp = _storage_nlp()
+    mesh = scenario_mesh(8)
+    rng = np.random.default_rng(6)
+    solve = scenario_sharded_solver(nlp, mesh, batched_keys=("price",),
+                                    max_iter=60, full_result=True)
+    n_scen = 5
+    res = solve({"price": rng.uniform(1.0, 10.0, (n_scen, 8))})
+    leaves = jax.tree_util.tree_leaves(res)
+    assert leaves and all(np.shape(leaf)[0] == n_scen for leaf in leaves)
+
+
 def test_sharded_solver_rejects_undeclared_key():
     nlp = _storage_nlp()
     mesh = scenario_mesh(4)

@@ -1,0 +1,402 @@
+"""Sweep-engine tests: declarative specs (grid/LHS/synhist axes),
+sharded chunked execution through all three backends, chunk-level
+checkpoint/resume determinism (bitwise), non-finite quarantine, the
+``--report`` CLI, and the sweep->surrogate handoff — the managed
+counterpart of the reference's shell-loop design sweeps (SURVEY.md §3),
+per MPAX / "Many Problems, One GPU": the managed batch is the unit of
+work, not the single solve."""
+
+import hashlib
+import json
+from pathlib import Path
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.analysis.flags import flag_enabled
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.sweep import (
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    ResultStore,
+    SweepOptions,
+    SweepSpec,
+    grid,
+    lhs,
+    run_sweep,
+    synhist,
+    train_revenue_surrogate,
+)
+
+T = 6
+_PDLP = {"tol": 1e-7, "dtype": "float64"}
+
+
+def _storage_nlp(T=T):
+    fs = Flowsheet(horizon=T)
+    fs.add_var("charge", lb=0, ub=1)
+    fs.add_var("discharge", lb=0, ub=1)
+    fs.add_var("soc", lb=0, ub=3)
+    fs.add_var("soc0", shape=(), lb=0)
+    fs.fix("soc0", 0.0)
+    fs.add_param("price", np.ones(T))
+    fs.add_eq(
+        "soc",
+        lambda v, p: v["soc"] - tshift(v["soc"], v["soc0"])
+        - v["charge"] + v["discharge"],
+    )
+    return fs.compile(
+        objective=lambda v, p: jnp.sum(
+            p["price"] * (v["discharge"] - v["charge"])),
+        sense="max",
+    )
+
+
+@pytest.fixture(scope="module")
+def nlp():
+    return _storage_nlp()
+
+
+def _spec(n_profiles=4, n_lhs=3):
+    rng = np.random.default_rng(0)
+    return SweepSpec((
+        grid("price", rng.uniform(1.0, 10.0, (n_profiles, T))),
+        lhs({"soc0": (0.0, 1.0)}, n_lhs, seed=1),
+    ))
+
+
+def _opts(**kw):
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("solver", "pdlp")
+    kw.setdefault("solver_options", _PDLP)
+    return SweepOptions(**kw)
+
+
+@pytest.fixture(scope="module")
+def ref_store(nlp, tmp_path_factory):
+    """One canonical completed direct-backend run of the canonical spec,
+    shared by every read-only consumer (parity, resume references, CLI)
+    so the tier-1 lane pays for it once."""
+    d = tmp_path_factory.mktemp("sweep") / "ref"
+    return run_sweep(nlp, _spec(), store_dir=d, options=_opts())
+
+
+# -- spec ---------------------------------------------------------------
+
+
+def test_spec_cartesian_product_and_inputs():
+    spec = _spec(4, 3)
+    assert spec.n_points == 12
+    assert spec.shape == (4, 3)
+    assert spec.swept_names == ("price", "soc0")
+    # profile axis contributes its realization INDEX as the design
+    # coordinate; scalar axis contributes its value
+    assert spec.input_names == ("price__realization", "soc0")
+    X = spec.inputs_for(np.arange(12))
+    assert X.shape == (12, 2)
+    np.testing.assert_array_equal(X[:, 0], np.repeat(np.arange(4), 3))
+    vals = spec.values_for([0, 3, 11])
+    assert vals["price"].shape == (3, T)
+    assert vals["soc0"].shape == (3,)
+
+
+def test_lhs_axis_is_stratified():
+    ax = lhs({"a": (2.0, 4.0), "b": (-1.0, 0.0)}, 8, seed=7)
+    for (lo, hi), col in zip(((2.0, 4.0), (-1.0, 0.0)), ax.values):
+        assert np.all((col >= lo) & (col <= hi))
+        # exactly one sample per stratum (the Latin property)
+        bins = np.floor((col - lo) / (hi - lo) * 8).astype(int)
+        assert sorted(bins) == list(range(8))
+
+
+def test_spec_fingerprint_tracks_content():
+    spec = _spec()
+    assert spec.fingerprint() == _spec().fingerprint()
+    assert spec.fingerprint() != _spec(n_profiles=5).fingerprint()
+    assert (SweepSpec((lhs({"soc0": (0.0, 1.0)}, 3, seed=1),)).fingerprint()
+            != SweepSpec((lhs({"soc0": (0.0, 1.0)}, 3, seed=2),)).fingerprint())
+
+
+def test_spec_rejects_duplicate_names():
+    with pytest.raises(ValueError, match="two axes"):
+        SweepSpec((grid("price", np.ones((2, T))),
+                   grid("price", np.ones((3, T)))))
+
+
+def test_synhist_axis_shapes():
+    from dispatches_tpu.utils.synhist import ARMAModel
+
+    model = ARMAModel(phi=[0.5], theta=[], sigma=1.0,
+                      seasonal_mean=[30.0, 35.0, 40.0, 38.0, 33.0, 31.0])
+    ax = synhist("price", model, n=5, n_steps=T, seed=3)
+    assert ax.values[0].shape == (5, T)
+    # sampling is seeded: same construction -> same axis -> same spec id
+    ax2 = synhist("price", model, n=5, n_steps=T, seed=3)
+    np.testing.assert_array_equal(ax.values[0], ax2.values[0])
+
+
+# -- engine: direct backend --------------------------------------------
+
+
+def test_run_sweep_direct_matches_single_solves(nlp, ref_store):
+    spec = _spec()
+    store = ref_store
+    assert store.is_complete
+    a = store.arrays()
+    assert a["obj"].shape == (12,)
+    assert np.all(a["status"] == STATUS_OK)
+    assert np.all(a["converged"])
+    np.testing.assert_array_equal(a["index"], np.arange(12))
+
+    # cross-check two points against unbatched solves
+    from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
+
+    base = make_pdlp_solver(nlp, PDLPOptions(**_PDLP))
+    for i in (0, 11):
+        vals = spec.values_for([i])
+        params = nlp.default_params()
+        params["p"]["price"] = vals["price"][0]
+        params["fixed"]["soc0"] = vals["soc0"][0]
+        ref = base(params)
+        assert a["obj"][i] == pytest.approx(float(ref.obj), abs=1e-6)
+
+
+def test_run_sweep_unknown_name_raises(nlp, tmp_path):
+    spec = SweepSpec((grid("not_a_param", np.ones(3)),))
+    with pytest.raises(KeyError, match="not_a_param"):
+        run_sweep(nlp, spec, store_dir=tmp_path / "s", options=_opts())
+
+
+def test_run_sweep_refuses_overwrite_without_flag(nlp, ref_store):
+    spec = _spec()
+    with pytest.raises(FileExistsError):
+        run_sweep(nlp, spec, store_dir=ref_store.path, options=_opts())
+    # resume of a COMPLETE store is a no-op returning the same results
+    st = run_sweep(nlp, spec, store_dir=ref_store.path, options=_opts(),
+                   resume=True)
+    assert st.is_complete
+
+
+def test_resume_refuses_different_spec(nlp, ref_store):
+    with pytest.raises(ValueError, match="fingerprint"):
+        run_sweep(nlp, _spec(n_profiles=5), store_dir=ref_store.path,
+                  options=_opts(), resume=True)
+
+
+def test_sweep_options_from_env(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_SWEEP_CHUNK", "16")
+    monkeypatch.setenv("DISPATCHES_TPU_SWEEP_MAX_RETRIES", "3")
+    monkeypatch.setenv("DISPATCHES_TPU_SWEEP_RESULT_DIR", "/tmp/sw")
+    opts = SweepOptions.from_env(backend="serve")
+    assert (opts.chunk_size, opts.max_retries, opts.result_dir,
+            opts.backend) == (16, 3, "/tmp/sw", "serve")
+
+
+# -- resume determinism ------------------------------------------------
+
+
+def _identity_hashes(root):
+    """Hashes of every file that is part of the store's identity (the
+    manifest + chunk arrays; progress.json is run telemetry)."""
+    out = {}
+    for f in sorted(Path(root).rglob("*")):
+        if f.is_file() and f.name != "progress.json":
+            out[str(f.relative_to(root))] = hashlib.blake2b(
+                f.read_bytes()).hexdigest()
+    return out
+
+
+def test_resume_after_interrupt_is_bitwise_identical(nlp, tmp_path,
+                                                     ref_store):
+    """Kill after the first chunk, resume, and compare EVERY identity
+    byte (manifest + chunk npz/json) against an uninterrupted run."""
+    spec = _spec()
+    assert ref_store.is_complete
+
+    class Killed(RuntimeError):
+        pass
+
+    def die_after_first(cid, n_chunks):
+        raise Killed(f"killed after chunk {cid}/{n_chunks}")
+
+    with pytest.raises(Killed):
+        run_sweep(nlp, spec, store_dir=tmp_path / "cut", options=_opts(),
+                  on_chunk=die_after_first)
+    cut = ResultStore(tmp_path / "cut")
+    assert cut.completed == {0} and not cut.is_complete
+
+    resumed_cids = []
+    st = run_sweep(nlp, spec, store_dir=tmp_path / "cut", options=_opts(),
+                   resume=True,
+                   on_chunk=lambda cid, n: resumed_cids.append(cid))
+    assert st.is_complete
+    # resume ran ONLY the chunks the kill left pending
+    assert resumed_cids == [1, 2]
+    assert _identity_hashes(ref_store.path) == _identity_hashes(
+        tmp_path / "cut")
+
+
+def test_resume_via_max_chunks_partial_runs(nlp, tmp_path, ref_store):
+    """Budgeted partial runs (max_chunks) accumulate to the identical
+    store as one uninterrupted run — resume from ANY chunk boundary."""
+    spec = _spec()
+    for _ in range(3):
+        st = run_sweep(nlp, spec, store_dir=tmp_path / "step",
+                       options=_opts(max_chunks=1), resume=True)
+    assert st.is_complete
+    assert _identity_hashes(ref_store.path) == _identity_hashes(
+        tmp_path / "step")
+    np.testing.assert_array_equal(ref_store.objectives(), st.objectives())
+
+
+# -- quarantine --------------------------------------------------------
+
+
+class FakeResult(NamedTuple):
+    obj: jnp.ndarray
+    converged: jnp.ndarray
+    iterations: jnp.ndarray
+
+
+def _poisoned_solver(params):
+    """Deterministic stand-in kernel: points whose price[0] > 8 come
+    back NaN (the non-finite lane a diverged solve produces)."""
+    price = params["p"]["price"]
+    bad = price[0] > 8.0
+    return FakeResult(jnp.where(bad, jnp.nan, jnp.sum(price)),
+                      ~bad, jnp.asarray(3))
+
+
+def test_nonfinite_points_quarantined_not_poisoning(nlp, tmp_path):
+    rng = np.random.default_rng(2)
+    profiles = rng.uniform(1.0, 7.0, (8, T))
+    profiles[2, 0] = 9.5
+    profiles[5, 0] = 9.9
+    spec = SweepSpec((grid("price", profiles),))
+    store = run_sweep(
+        nlp, spec, store_dir=tmp_path / "q",
+        options=SweepOptions(chunk_size=4, solver=_poisoned_solver,
+                             max_retries=2))
+    a = store.arrays()
+    assert list(a["status"]) == [0, 0, 2, 0, 0, 2, 0, 0]
+    assert list(a["retries"]) == [0, 0, 2, 0, 0, 2, 0, 0]
+    # quarantined points carry NaN; every other lane in their chunks
+    # solved normally (never poisoned)
+    assert np.isnan(a["obj"][[2, 5]]).all()
+    good = np.delete(np.arange(8), [2, 5])
+    np.testing.assert_allclose(a["obj"][good], profiles[good].sum(axis=1))
+    assert not a["converged"][[2, 5]].any()
+    # and the surrogate handoff never sees them
+    X, y = store.training_data()
+    assert len(y) == 6 and np.isfinite(y).all()
+    assert store.summary()["quarantined"] == 2
+
+
+# -- backends ----------------------------------------------------------
+
+
+def test_all_three_backends_match(nlp, tmp_path, ref_store):
+    """One SweepSpec through direct, mesh-sharded, and serve backends:
+    same objectives (the acceptance bar for backend interchange)."""
+    from dispatches_tpu.parallel import scenario_mesh
+
+    spec = _spec()
+    direct = ref_store
+    mesh = run_sweep(nlp, spec, store_dir=tmp_path / "mesh",
+                     options=_opts(backend="mesh"),
+                     mesh=scenario_mesh(4))
+    # serve through a caller-owned SolveService (the one-shared-with-
+    # live-traffic deployment): its metrics must see the sweep
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e12,
+                                    warm_start=False))
+    serve = run_sweep(nlp, spec, store_dir=tmp_path / "serve",
+                      options=_opts(backend="serve"), service=svc)
+    np.testing.assert_allclose(mesh.objectives(), direct.objectives(),
+                               rtol=1e-8, atol=1e-9)
+    np.testing.assert_allclose(serve.objectives(), direct.objectives(),
+                               rtol=1e-8, atol=1e-9)
+    for st in (direct, mesh, serve):
+        assert st.is_complete and np.all(st.statuses() == STATUS_OK)
+    m = svc.metrics()
+    assert m["solved"] == spec.n_points
+    assert m["occupancy_mean"] == 1.0  # chunk==max_batch: full lanes
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_report_cli(nlp, tmp_path, ref_store, capsys):
+    from dispatches_tpu.sweep.__main__ import main
+
+    store = ref_store
+    assert main(["--report", str(store.path)]) == 0
+    out = capsys.readouterr().out
+    assert store.fingerprint[:12] in out
+    assert "chunks 3/3 done" in out
+    assert "throughput" in out
+
+    assert main(["--report", "--json", str(store.path)]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["chunks_done"] == 3
+    assert payload["points_done"] == 12
+
+    assert main(["--report", str(tmp_path / "nope")]) == 2
+
+
+# -- surrogate handoff -------------------------------------------------
+
+
+def test_sweep_trains_revenue_surrogate(nlp, ref_store):
+    """A finished store feeds workflow.surrogates directly: labels come
+    from sweep objectives, no hand-rolled assembly."""
+    from dispatches_tpu.workflow.surrogates import TrainNNSurrogates
+
+    store = ref_store
+    trainer, params = train_revenue_surrogate(
+        store, NN_size=[2, 8, 8, 1], epochs=60)
+    scaling = trainer._model_params
+    assert {"xm_inputs", "xstd_inputs", "y_mean", "y_std",
+            "R2", "train_loss"} <= set(scaling)
+    pred = trainer.predict(params, scaling, store.arrays()["inputs"][:3])
+    assert pred.shape == (3, 1) and np.isfinite(pred).all()
+    # the classmethod route builds the same trainer surface
+    t2 = TrainNNSurrogates.from_sweep(store)
+    x2, y2 = t2._transform_dict_to_array()
+    X, y = store.training_data()
+    np.testing.assert_array_equal(x2, X)
+    np.testing.assert_array_equal(y2[:, 0], y)
+
+
+@pytest.mark.skipif(not flag_enabled("SLOW"),
+                    reason="slow lane (DISPATCHES_TPU_SLOW=1)")
+def test_sweep_to_surrogate_end_to_end_slow(nlp, tmp_path):
+    """Bigger loop in the slow lane: synhist LMP axis x LHS design
+    axis through the serve backend, then a revenue MLP that actually
+    fits the (smooth) revenue surface."""
+    from dispatches_tpu.utils.synhist import ARMAModel
+
+    model = ARMAModel(phi=[0.6], theta=[], sigma=0.8,
+                      seasonal_mean=[28.0, 33.0, 41.0, 39.0, 31.0, 27.0])
+    spec = SweepSpec((
+        synhist("price", model, n=16, n_steps=T, seed=11),
+        lhs({"soc0": (0.0, 1.5)}, 4, seed=5),
+    ))
+    store = run_sweep(nlp, spec, store_dir=tmp_path / "big",
+                      options=_opts(chunk_size=16, backend="serve"))
+    assert store.is_complete and store.n_points == 64
+    trainer, params = train_revenue_surrogate(
+        store, NN_size=[2, 16, 16, 1], epochs=400)
+    r2 = trainer._model_params["R2"]
+    assert r2 is not None and np.isfinite(r2).all()
+    X, y = store.training_data()
+    pred = trainer.predict(params, trainer._model_params, X)[:, 0]
+    # in-sample fit on a smooth surface: explains most of the variance
+    ss_res = float(np.sum((y - pred) ** 2))
+    ss_tot = float(np.sum((y - y.mean()) ** 2))
+    assert 1.0 - ss_res / ss_tot > 0.5
