@@ -41,6 +41,10 @@ REGISTERED_FLAGS = {
     "non-finite result is quarantined (sweep.SweepOptions.from_env)",
     "SWEEP_RESULT_DIR": "sweep-engine default ResultStore directory "
     "(sweep.SweepOptions.from_env)",
+    "OBS": "enable span/instant recording in the obs tracer "
+    "(obs.trace; disabled-by-default fast path otherwise)",
+    "OBS_BUFFER": "obs tracer ring-buffer capacity in events "
+    "(obs.trace; default 65536, oldest events dropped)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

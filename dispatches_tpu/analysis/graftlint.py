@@ -28,6 +28,13 @@ GL005 bare-astype-f64       ``astype(float64)`` in a module that never
 GL006 unregistered-env-flag ``DISPATCHES_TPU_*`` environment reads not
                             registered in ``analysis.flags`` —
                             undocumented knobs.
+GL007 unfenced-timing       a ``time.perf_counter()``/``time.time()``
+                            window around a call to a jit-compiled
+                            callable with no ``jax.block_until_ready``
+                            (or ``obs`` span ``fence``) inside it — JAX
+                            dispatch is asynchronous, so the stop
+                            timestamp measures dispatch latency, not
+                            the solve (the sweep points/s bug).
 
 Findings are reported as ``file:line rule-id message`` and fingerprinted
 by (relpath, rule, normalized source line) — line-number independent, so
@@ -58,6 +65,7 @@ RULES: Dict[str, str] = {
     "GL004": "hot-loop-array",
     "GL005": "bare-astype-f64",
     "GL006": "unregistered-env-flag",
+    "GL007": "unfenced-timing",
 }
 
 DEFAULT_BASELINE = Path(__file__).with_name("graftlint.baseline")
@@ -113,6 +121,10 @@ _STATIC_CALLS = {"len", "isinstance", "hasattr", "callable", "getattr",
 _JNP_CONSTRUCTORS = {"asarray", "array", "zeros", "ones", "full", "arange",
                      "linspace", "eye", "concatenate", "stack", "diag"}
 _HOT_RE = re.compile(r"(^|[^a-z])(hour|hr|day|date)s?([^a-z]|$)")
+# GL007: wrappers whose result is an async-dispatching compiled callable
+_JIT_WRAPPERS = {"jit", "pjit", "graft_jit"}
+_TIMER_ATTRS = {"perf_counter", "perf_counter_ns", "time", "monotonic"}
+_FENCE_NAMES = {"block_until_ready", "fence"}
 
 
 def _base_name(func: ast.expr) -> Optional[str]:
@@ -193,6 +205,7 @@ class _TracedRoots(ast.NodeVisitor):
         self.traced_names: Set[str] = set()
         self.traced_nodes: Set[int] = set()  # ids of Lambda/def nodes
         self.f64_aliases: Set[str] = set()
+        self.jitted_names: Set[str] = set()  # names bound to jit results
         self.has_x64_guard = False
 
     def _mark(self, expr: ast.expr) -> None:
@@ -224,11 +237,15 @@ class _TracedRoots(ast.NodeVisitor):
                 base = _base_name(dec.func)
             if base in _TRANSFORM_ARG_SLOTS:
                 self.traced_nodes.add(id(node))
+                if base in _JIT_WRAPPERS:
+                    self.jitted_names.add(node.name)
             # @partial(jax.jit, ...) — partial's first arg is the transform
             if (isinstance(dec, ast.Call)
                     and _base_name(dec.func) == "partial" and dec.args
                     and _base_name(dec.args[0]) in _TRANSFORM_ARG_SLOTS):
                 self.traced_nodes.add(id(node))
+                if _base_name(dec.args[0]) in _JIT_WRAPPERS:
+                    self.jitted_names.add(node.name)
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_decorators(node)
@@ -243,6 +260,15 @@ class _TracedRoots(ast.NodeVisitor):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     self.f64_aliases.add(t.id)
+        # solver = jax.jit(...) / graft_jit(...) bindings (GL007): calls
+        # of these names dispatch asynchronously
+        if (isinstance(node.value, ast.Call)
+                and _base_name(node.value.func) in _JIT_WRAPPERS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.jitted_names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    self.jitted_names.add(t.attr)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -286,6 +312,12 @@ class _Linter:
 
     def run(self) -> List[Finding]:
         self._walk(self.tree, in_traced=False, hot_depth=0)
+        # GL007 operates per lexical scope: the module body plus every
+        # function body (shallow — nested defs are scopes of their own)
+        self._check_gl007_scope(self.tree)
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES):
+                self._check_gl007_scope(node)
         # dedupe (a node can be reachable twice through traced nesting)
         seen: Set[tuple] = set()
         out = []
@@ -410,6 +442,48 @@ class _Linter:
                     node, "GL001",
                     f"`.{node.func.attr}()` inside a traced function — "
                     "host materialization of a traced value",
+                )
+
+    def _check_gl007_scope(self, scope: ast.AST) -> None:
+        """Un-fenced host timing around an async-dispatching call.
+
+        Within one lexical scope: two or more ``time.perf_counter()`` /
+        ``time.time()`` reads define a timing window; a call to a name
+        bound to ``jax.jit``/``pjit``/``graft_jit`` output inside that
+        window, with no ``block_until_ready`` (or obs-span ``fence``)
+        in the window, measures dispatch latency, not the computation.
+        """
+        timers: List[int] = []
+        fences: List[int] = []
+        jit_calls: List[ast.Call] = []
+        for node in _shallow_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and _root_name(f) == "time"
+                    and f.attr in _TIMER_ATTRS):
+                timers.append(node.lineno)
+            elif isinstance(f, ast.Name) and f.id in _TIMER_ATTRS:
+                timers.append(node.lineno)
+            base = _base_name(f)
+            if base in _FENCE_NAMES:
+                fences.append(node.lineno)
+            elif base in self.roots.jitted_names:
+                jit_calls.append(node)
+        if len(timers) < 2:
+            return
+        lo, hi = min(timers), max(timers)
+        if any(lo <= ln <= hi for ln in fences):
+            return
+        for call in jit_calls:
+            if lo <= call.lineno <= hi:
+                self._emit(
+                    call, "GL007",
+                    f"`{_base_name(call.func)}()` (jit-compiled) inside "
+                    "a host timing window with no jax.block_until_ready "
+                    "— async dispatch returns before the device "
+                    "finishes, so the timer measures dispatch, not the "
+                    "solve; fence the result before the stop timestamp",
                 )
 
     def _check_gl003(self, node: ast.Call) -> None:

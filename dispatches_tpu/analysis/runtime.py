@@ -75,6 +75,24 @@ _lock = threading.Lock()
 _COUNTERS: List[_CompileCounter] = []
 
 
+def _emit_compile_event(label: str, count: int) -> None:
+    """Feed one trace event (= compile) into the obs layer.
+
+    Imported lazily: compiles are rare, and the obs modules themselves
+    depend only on the stdlib plus ``analysis.flags``, so the deferred
+    import keeps this module's discipline (stdlib + flags) intact.
+    """
+    try:
+        from dispatches_tpu.obs import registry, trace
+
+        trace.instant("compile", label=label, count=count)
+        registry.counter(
+            "graft.compiles", "graft_jit traces (= jit cache misses)"
+        ).inc(label=label)
+    except Exception:  # never let telemetry break a trace in progress
+        pass
+
+
 def graft_jit(fun: Callable, *, label: Optional[str] = None, **jit_kwargs):
     """``jax.jit`` with recompile accounting.
 
@@ -104,6 +122,7 @@ def graft_jit(fun: Callable, *, label: Optional[str] = None, **jit_kwargs):
                 RecompileWarning,
                 stacklevel=3,
             )
+        _emit_compile_event(counter.label, counter.count)
         return fun(*args, **kwargs)
 
     jitted = jax.jit(_counted, **jit_kwargs)

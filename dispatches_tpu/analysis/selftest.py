@@ -107,6 +107,32 @@ CORPUS: Dict[str, Dict[str, str]] = {
                 return x.astype(jnp.float64)
         """,
     },
+    "GL007": {
+        "bad": """
+            import time
+            import jax
+
+            solver = jax.jit(lambda p: p * 2.0)
+
+            def bench(params):
+                t0 = time.perf_counter()
+                res = solver(params)
+                elapsed = time.perf_counter() - t0
+                return res, elapsed
+        """,
+        "good": """
+            import time
+            import jax
+
+            solver = jax.jit(lambda p: p * 2.0)
+
+            def bench(params):
+                t0 = time.perf_counter()
+                res = jax.block_until_ready(solver(params))
+                elapsed = time.perf_counter() - t0
+                return res, elapsed
+        """,
+    },
     "GL006": {
         "bad": """
             import os

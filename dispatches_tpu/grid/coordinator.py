@@ -17,6 +17,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from dispatches_tpu.obs import trace as obs_trace
+
 
 def convert_marginal_costs_to_actual_costs(bid_pairs):
     """[(power, marginal $/MWh)...] -> [(power, cumulative $)] (the
@@ -116,18 +118,21 @@ class DoubleLoopCoordinator:
 
     def request_da_bids(self, date):
         pre = getattr(self, "_da_prefetch", None)
-        if pre and date in pre:
-            bids = pre.pop(date)
-        else:
-            bids = self.bidder.compute_day_ahead_bids(date=date)
-        self.bidder.record_bids(bids, date, 0, market="Day-ahead")
+        with obs_trace.span("bid.da", date=date,
+                            prefetched=bool(pre and date in pre)):
+            if pre and date in pre:
+                bids = pre.pop(date)
+            else:
+                bids = self.bidder.compute_day_ahead_bids(date=date)
+            self.bidder.record_bids(bids, date, 0, market="Day-ahead")
         return bids
 
     def request_rt_bids(self, date, hour, da_lmp=None):
-        bids = self.bidder.compute_real_time_bids(
-            date, hour, realized_day_ahead_prices=da_lmp
-        )
-        self.bidder.record_bids(bids, date, hour, market="Real-time")
+        with obs_trace.span("bid.rt", date=date, hour=hour):
+            bids = self.bidder.compute_real_time_bids(
+                date, hour, realized_day_ahead_prices=da_lmp
+            )
+            self.bidder.record_bids(bids, date, hour, market="Real-time")
         return bids
 
     def push_da_results(self, date, da_lmp, da_dispatch, bus_lmps):
@@ -149,6 +154,10 @@ class DoubleLoopCoordinator:
         """Track the cleared real-time dispatch; feed realized prices
         back to the forecaster (reference coordinator's hourly stats
         callback)."""
+        with obs_trace.span("track.rt", date=date, hour=hour):
+            return self._push_rt_dispatch(date, hour, dispatch_mw, bus_lmps)
+
+    def _push_rt_dispatch(self, date, hour, dispatch_mw, bus_lmps):
         h = self.tracker.tracking_horizon
         signal = np.full(h, float(dispatch_mw))
         self.tracker.track_market_dispatch(signal, date=date, hour=hour)

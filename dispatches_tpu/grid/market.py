@@ -37,6 +37,7 @@ import pandas as pd
 
 from dispatches_tpu.core.config import config, config_field
 from dispatches_tpu.core.graph import Flowsheet
+from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
 
 N_SEG = 3  # thermal cost curves: RTS heat-rate tables carry 3 increments
@@ -863,39 +864,44 @@ class MarketSimulator:
                     self.coordinator.prefetch_da_bids(window, mesh=mesh)
                 da_bids = self.coordinator.request_da_bids(date)
 
-            u = solve_unit_commitment(
-                uc_case,
-                hours,
-                reserve_factor=self.reserve_factor,
-                use_milp=self.use_milp,
-                initial_state=uc_state,
-            )
-            # advance the carried state over the implemented day
-            n_impl = min(24, H)
-            new_on = uc_state["on"].copy()
-            new_hours = uc_state["hours"].copy()
-            for g in range(u.shape[1]):
-                col = u[:n_impl, g] > 0.5
-                run = 1
-                while run < n_impl and col[n_impl - 1 - run] == col[-1]:
-                    run += 1
-                if run == n_impl and bool(col[-1]) == bool(uc_state["on"][g]):
-                    run += int(uc_state["hours"][g])  # run spans the day
-                new_on[g] = bool(col[-1])
-                new_hours[g] = run
-            uc_state = {"on": new_on, "hours": new_hours}
-            params = self._da_lp.params_for(
-                hours, u, rt=False, participant_bids=da_bids
-            )
-            res, sol, da_lmp = self._da_lp.solve(params)
-            da_dispatch = self._collect_dispatch(self._da_lp, sol, u)
-
-            if self.coordinator is not None:
-                pp_da = self._participant_power(self._da_lp, sol)
-                self.coordinator.push_da_results(
-                    date, da_lmp, pp_da,
-                    {b: da_lmp[:24, i] for i, b in enumerate(case.buses)},
+            # RUC cycle: unit commitment + the day-ahead pricing LP
+            # (the LP solve syncs to host for LMP math, so the span's
+            # wall-clock covers device completion)
+            with obs_trace.span("market.ruc", date=date):
+                u = solve_unit_commitment(
+                    uc_case,
+                    hours,
+                    reserve_factor=self.reserve_factor,
+                    use_milp=self.use_milp,
+                    initial_state=uc_state,
                 )
+                # advance the carried state over the implemented day
+                n_impl = min(24, H)
+                new_on = uc_state["on"].copy()
+                new_hours = uc_state["hours"].copy()
+                for g in range(u.shape[1]):
+                    col = u[:n_impl, g] > 0.5
+                    run = 1
+                    while run < n_impl and col[n_impl - 1 - run] == col[-1]:
+                        run += 1
+                    if (run == n_impl
+                            and bool(col[-1]) == bool(uc_state["on"][g])):
+                        run += int(uc_state["hours"][g])  # spans the day
+                    new_on[g] = bool(col[-1])
+                    new_hours[g] = run
+                uc_state = {"on": new_on, "hours": new_hours}
+                params = self._da_lp.params_for(
+                    hours, u, rt=False, participant_bids=da_bids
+                )
+                res, sol, da_lmp = self._da_lp.solve(params)
+                da_dispatch = self._collect_dispatch(self._da_lp, sol, u)
+
+                if self.coordinator is not None:
+                    pp_da = self._participant_power(self._da_lp, sol)
+                    self.coordinator.push_da_results(
+                        date, da_lmp, pp_da,
+                        {b: da_lmp[:24, i] for i, b in enumerate(case.buses)},
+                    )
 
             # ---- hourly SCED over the settlement day (bounded by the
             # RUC horizon when ruc_horizon < 24) -------------------
@@ -905,30 +911,33 @@ class MarketSimulator:
                 sced_hours = np.clip(
                     np.arange(h_abs, h_abs + Hs), 0, case.n_hours - 1
                 )
-                rt_bids = None
-                if self.coordinator is not None:
-                    rt_bids = self.coordinator.request_rt_bids(
-                        date, hr, da_lmp
+                # SCED cycle: bid refresh + the real-time pricing LP
+                with obs_trace.span("market.sced", date=date, hour=hr):
+                    rt_bids = None
+                    if self.coordinator is not None:
+                        rt_bids = self.coordinator.request_rt_bids(
+                            date, hr, da_lmp
+                        )
+                    u_h = u[np.clip(np.arange(hr, hr + Hs), 0, H - 1)]
+                    p_rt = self._rt_lp.params_for(
+                        sced_hours, u_h, rt=True, participant_bids=rt_bids
                     )
-                u_h = u[np.clip(np.arange(hr, hr + Hs), 0, H - 1)]
-                p_rt = self._rt_lp.params_for(
-                    sced_hours, u_h, rt=True, participant_bids=rt_bids
-                )
-                res_rt, sol_rt, rt_lmp = self._rt_lp.solve(p_rt)
+                    res_rt, sol_rt, rt_lmp = self._rt_lp.solve(p_rt)
 
-                # settlement + logs for the implemented hour (index 0)
-                sys_load = float(case.load_rt[h_abs].sum())
-                shed = float(sol_rt["shed"][0])
-                total_cost += float(res_rt.obj) / Hs
-                pp_rt = 0.0
-                if self.coordinator is not None:
-                    pp_rt = float(
-                        self._participant_power(self._rt_lp, sol_rt)[0]
-                    )
-                    self.coordinator.push_rt_dispatch(
-                        date, hr, pp_rt,
-                        {b: rt_lmp[0, i] for i, b in enumerate(case.buses)},
-                    )
+                    # settlement + logs for the implemented hour (index 0)
+                    sys_load = float(case.load_rt[h_abs].sum())
+                    shed = float(sol_rt["shed"][0])
+                    total_cost += float(res_rt.obj) / Hs
+                    pp_rt = 0.0
+                    if self.coordinator is not None:
+                        pp_rt = float(
+                            self._participant_power(self._rt_lp, sol_rt)[0]
+                        )
+                        self.coordinator.push_rt_dispatch(
+                            date, hr, pp_rt,
+                            {b: rt_lmp[0, i]
+                             for i, b in enumerate(case.buses)},
+                        )
                 summary_rows.append(
                     {
                         "Date": date,

@@ -1,0 +1,47 @@
+"""dispatches_tpu.obs — unified tracing, metrics, and solver telemetry.
+
+Three pieces, one import surface:
+
+* :mod:`~dispatches_tpu.obs.registry` — process-wide labeled counters /
+  gauges / histograms (``serve``'s ``--stats`` is built on it);
+* :mod:`~dispatches_tpu.obs.trace` — contextvar span tracer with
+  explicit device fencing and Chrome-trace export (Perfetto);
+* :mod:`~dispatches_tpu.obs.solverlog` — decode per-iteration IPM /
+  PDLP / Newton convergence telemetry captured inside the jitted solve.
+
+Everything here is disabled by default; set ``DISPATCHES_TPU_OBS=1``
+(or call :func:`enable`) to record, and run
+``python -m dispatches_tpu.obs --report`` for the rollup.
+"""
+
+from dispatches_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    default_registry,
+    diff_snapshots,
+    gauge,
+    histogram,
+)
+from dispatches_tpu.obs.solverlog import (  # noqa: F401
+    ConvergenceTrace,
+    decode_ipm,
+    decode_newton,
+    decode_pdlp,
+)
+from dispatches_tpu.obs.trace import (  # noqa: F401
+    enable,
+    enabled,
+    events,
+    export_chrome_trace,
+    instant,
+    reset,
+    span,
+)
+from dispatches_tpu.obs.report import (  # noqa: F401
+    aggregate_spans,
+    format_report,
+    load_chrome_trace,
+)

@@ -1,0 +1,102 @@
+"""CLI for the observability layer.
+
+::
+
+    # run the built-in demo workload with tracing on, print the rollup
+    python -m dispatches_tpu.obs --report
+    python -m dispatches_tpu.obs --report --json
+
+    # also write the Chrome trace (open in https://ui.perfetto.dev)
+    python -m dispatches_tpu.obs --report --export-trace /tmp/trace.json
+
+    # aggregate a previously exported trace file instead of running
+    python -m dispatches_tpu.obs --report --trace-file /tmp/trace.json
+
+The demo workload is a small batch-serve session (the same battery
+arbitrage LP the serve CLI uses) with obs force-enabled, so the report
+exercises the real instrumentation: serve batch spans, ``graft_jit``
+compile instants, and the registry counters they feed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from dispatches_tpu.obs import registry, report, trace
+
+
+def _demo_workload() -> None:
+    """Tiny serve session under forced tracing (2 requests, T=4)."""
+    import numpy as np
+
+    from dispatches_tpu.serve import ServeOptions, SolveService
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+
+    service = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9))
+    nlp = _arbitrage_nlp(4)
+    defaults = nlp.default_params()
+    rng = np.random.default_rng(0)
+    handles = []
+    for _ in range(2):
+        price = 30.0 + 10.0 * rng.standard_normal(4)
+        params = {"p": {**defaults["p"], "price": price},
+                  "fixed": defaults["fixed"]}
+        handles.append(service.submit(nlp, params, solver="pdlp"))
+    service.flush_all()
+    for h in handles:
+        h.result()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dispatches_tpu.obs",
+        description="tracing/metrics report for dispatches_tpu",
+    )
+    parser.add_argument("--report", action="store_true",
+                        help="print the span/metrics rollup")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON instead of text")
+    parser.add_argument("--export-trace", metavar="PATH",
+                        help="write buffered events as Chrome trace JSON")
+    parser.add_argument("--trace-file", metavar="PATH",
+                        help="aggregate an exported trace file instead of "
+                             "running the demo workload")
+    args = parser.parse_args(argv)
+
+    if not (args.report or args.export_trace):
+        parser.print_help()
+        return 2
+
+    if args.trace_file:
+        events = report.load_chrome_trace(args.trace_file)
+        snapshot = None
+    else:
+        trace.enable(True)
+        _demo_workload()
+        events = trace.events()
+        snapshot = registry.default_registry().snapshot()
+
+    if args.export_trace:
+        n = trace.export_chrome_trace(args.export_trace, events)
+        print(f"wrote {n} event(s) to {args.export_trace}", file=sys.stderr)
+
+    if args.report:
+        if args.json:
+            payload = {
+                "spans": report.aggregate_spans(events),
+                "metrics": snapshot or {},
+                "events_buffered": len(events),
+                "events_dropped": trace.dropped(),
+            }
+            print(json.dumps(payload, indent=2, sort_keys=True))
+        else:
+            print(report.format_report(events, snapshot,
+                                       dropped=trace.dropped()), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

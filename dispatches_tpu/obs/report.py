@@ -1,0 +1,91 @@
+"""Aggregate span/instant events and registry snapshots into reports.
+
+``python -m dispatches_tpu.obs --report`` renders this for the live
+process; drivers embed :func:`aggregate_spans` / :func:`format_report`
+to summarize a run they just traced (e.g. the double-loop co-sim test
+asserting that RUC/SCED/serve spans actually landed).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+__all__ = [
+    "aggregate_spans",
+    "format_report",
+    "load_chrome_trace",
+]
+
+
+def aggregate_spans(events: List[Dict]) -> Dict[str, Dict]:
+    """Per-name rollup of span (``ph: X``) and instant (``ph: i``)
+    events: ``{name: {count, total_ms, mean_ms, max_ms}}`` for spans,
+    ``{name: {count}}`` for instants."""
+    out: Dict[str, Dict] = {}
+    for e in events:
+        name = e.get("name", "?")
+        if e.get("ph") == "X":
+            agg = out.setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            )
+            dur_ms = e.get("dur", 0.0) / 1e3
+            agg["count"] += 1
+            agg["total_ms"] += dur_ms
+            agg["max_ms"] = max(agg["max_ms"], dur_ms)
+        elif e.get("ph") == "i":
+            agg = out.setdefault(name, {"count": 0})
+            agg["count"] += 1
+    for agg in out.values():
+        if "total_ms" in agg:
+            agg["mean_ms"] = round(agg["total_ms"] / max(agg["count"], 1), 3)
+            agg["total_ms"] = round(agg["total_ms"], 3)
+            agg["max_ms"] = round(agg["max_ms"], 3)
+    return out
+
+
+def format_report(events: List[Dict],
+                  registry_snapshot: Optional[Dict] = None,
+                  dropped: int = 0) -> str:
+    """Human-readable rollup: spans (sorted by total time), instants,
+    then the metrics-registry snapshot."""
+    agg = aggregate_spans(events)
+    spans = {n: a for n, a in agg.items() if "total_ms" in a}
+    instants = {n: a for n, a in agg.items() if "total_ms" not in a}
+
+    lines = ["== dispatches_tpu.obs report =="]
+    lines.append(f"events: {len(events)} buffered"
+                 + (f", {dropped} dropped" if dropped else ""))
+    if spans:
+        lines.append("spans:")
+        width = max(len(n) for n in spans)
+        for name in sorted(spans, key=lambda n: -spans[n]["total_ms"]):
+            a = spans[name]
+            lines.append(
+                f"  {name:<{width}}  {a['count']:6d} x  "
+                f"total {a['total_ms']:10.3f} ms  "
+                f"mean {a['mean_ms']:8.3f} ms  "
+                f"max {a['max_ms']:8.3f} ms"
+            )
+    if instants:
+        lines.append("instants:")
+        width = max(len(n) for n in instants)
+        for name in sorted(instants):
+            lines.append(f"  {name:<{width}}  {instants[name]['count']:6d} x")
+    if registry_snapshot:
+        lines.append("metrics:")
+        for name, entry in sorted(registry_snapshot.items()):
+            for label, val in sorted(entry["values"].items()):
+                series = f"{name}{{{label}}}" if label else name
+                lines.append(f"  {series} = {val}")
+    return "\n".join(lines) + "\n"
+
+
+def load_chrome_trace(path) -> List[Dict]:
+    """Read back a trace written by ``trace.export_chrome_trace`` (or
+    any Chrome trace-event JSON file)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, list):  # bare-array flavor of the format
+        return payload
+    return payload.get("traceEvents", [])

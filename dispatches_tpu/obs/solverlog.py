@@ -1,0 +1,146 @@
+"""Host-side decoding of per-iteration solver convergence telemetry.
+
+The batched-solver papers this stack builds on (MPAX; "many problems,
+one GPU") both land on the same operational lesson: a thousand-lane
+batch is undebuggable without per-iteration convergence visibility —
+one floored lane drags the whole ``while_loop`` batch to ``max_iter``
+and nothing in the final result says why.
+
+The capture side lives in the solvers themselves
+(``make_ipm_solver(..., trace=True)``, ``make_pdlp_solver(...,
+trace=True)``, ``make_newton_solver(..., trace=True)``): when tracing,
+the data-dependent ``lax.while_loop`` is replaced by a fixed-length
+``lax.scan`` whose body applies the original step under ``lax.cond``
+(finished lanes hold their state), recording a small dict of scalars
+per iteration/check.  That keeps every shape static and puts **no host
+callbacks in the hot loop** — telemetry is just one more device array
+in the jitted program's output, fetched with everything else.
+
+This module is the decode side: trim the fixed-length arrays at the
+iteration count actually used, select a lane out of a ``vmap`` batch,
+and render operator-facing tables.  It is NumPy-only at import time
+(no jax import), so the obs CLI stays light.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = [
+    "ConvergenceTrace",
+    "decode_ipm",
+    "decode_pdlp",
+    "decode_newton",
+]
+
+
+@dataclass
+class ConvergenceTrace:
+    """One lane's per-iteration telemetry, trimmed to the iterations
+    actually used."""
+
+    solver: str
+    iterations: int
+    columns: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return self.iterations
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def format(self, every: int = 1) -> str:
+        """Fixed-width iteration table (one row per recorded step)."""
+        names = list(self.columns)
+        header = "iter  " + "  ".join(f"{n:>12s}" for n in names)
+        lines = [header]
+        rows = len(next(iter(self.columns.values()))) if names else 0
+        for i in range(0, rows, max(every, 1)):
+            cells = []
+            for n in names:
+                v = self.columns[n][i]
+                if np.issubdtype(np.asarray(v).dtype, np.integer):
+                    cells.append(f"{int(v):>12d}")
+                else:
+                    cells.append(f"{float(v):>12.5e}")
+            lines.append(f"{i:4d}  " + "  ".join(cells))
+        return "\n".join(lines) + "\n"
+
+
+def _lane(arr, lane: int) -> np.ndarray:
+    """Select one vmap lane.  Trace arrays are (iters,) unbatched or
+    (batch, iters) under vmap (the batch axis leads after scan's
+    per-iteration leading axis is transposed out by vmap)."""
+    a = np.asarray(arr)
+    return a[lane] if a.ndim > 1 else a
+
+
+def _scalar(arr, lane: int) -> float:
+    a = np.asarray(arr).reshape(-1)
+    return float(a[lane] if a.size > 1 else a[0])
+
+
+def decode_ipm(trace, result=None, lane: int = 0) -> ConvergenceTrace:
+    """Decode ``make_ipm_solver(..., trace=True)`` telemetry.
+
+    Columns: ``mu`` (barrier parameter — monotone non-increasing by the
+    Fiacco-McCormick update), ``kkt_error``, ``alpha`` (accepted step),
+    ``stall``.  Rows past ``result.iterations`` (finished-lane holds)
+    are trimmed when ``result`` is given.
+    """
+    cols = {k: _lane(trace[k], lane)
+            for k in ("mu", "kkt_error", "alpha", "stall")}
+    rows = len(cols["mu"])
+    n_it = int(_scalar(result.iterations, lane)) if result is not None else rows
+    n_it = min(n_it, rows)
+    return ConvergenceTrace(
+        solver="ipm",
+        iterations=n_it,
+        columns={k: v[:n_it] for k, v in cols.items()},
+    )
+
+
+def decode_pdlp(trace, result=None, lane: int = 0) -> ConvergenceTrace:
+    """Decode ``make_pdlp_solver(..., trace=True)`` telemetry.
+
+    One row per termination check (every ``check_every`` iterations).
+    Columns: ``it`` (iteration count at the check), ``err`` (candidate
+    KKT error), ``err_best``, and the best-iterate components ``pr`` /
+    ``du`` / ``gap`` — so the row at ``it == result.iters`` carries the
+    same converged gap the :class:`LPResult` reports.
+    """
+    cols = {k: _lane(trace[k], lane)
+            for k in ("it", "err", "err_best", "pr", "du", "gap")}
+    rows = len(cols["it"])
+    if result is not None:
+        n_iters = int(_scalar(result.iters, lane))
+        # one recorded row per real check; finished lanes hold `it`
+        n_rows = int(np.searchsorted(cols["it"], n_iters, side="left")) + 1
+        n_rows = min(max(n_rows, 1), rows)
+    else:
+        n_rows = rows
+    return ConvergenceTrace(
+        solver="pdlp",
+        iterations=n_rows,
+        columns={k: v[:n_rows] for k, v in cols.items()},
+    )
+
+
+def decode_newton(trace, result=None, lane: int = 0) -> ConvergenceTrace:
+    """Decode ``make_newton_solver(..., trace=True)`` telemetry.
+
+    Columns: ``max_residual`` (inf-norm of the scaled residual after
+    each damped step).
+    """
+    cols = {"max_residual": _lane(trace["max_residual"], lane)}
+    rows = len(cols["max_residual"])
+    n_it = int(_scalar(result.iterations, lane)) if result is not None else rows
+    n_it = min(n_it, rows)
+    return ConvergenceTrace(
+        solver="newton",
+        iterations=n_it,
+        columns={k: v[:n_it] for k, v in cols.items()},
+    )

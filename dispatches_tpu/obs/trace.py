@@ -1,0 +1,233 @@
+"""Contextvar span tracer with device fencing and Chrome-trace export.
+
+Timing JAX code on the host is a known trap: dispatch is asynchronous,
+so a ``perf_counter`` stop right after a jitted call measures dispatch
+latency, not the solve (the sweep engine shipped exactly this bug —
+fixed alongside this module; graftlint GL007 now flags the pattern).
+The tracer makes the fence explicit: a span covering device work calls
+``sp.fence(result)``, which ALWAYS runs ``jax.block_until_ready`` —
+fencing is a timing-correctness operation, not telemetry, so it blocks
+whether or not tracing is enabled (the serve layer's batch latency
+accounting relies on this).
+
+Spans nest through a ``contextvars.ContextVar`` (each completed span
+records its parent), land in a bounded ring buffer (oldest dropped),
+and export as Chrome trace-event JSON — load the file in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Disabled-by-default fast path: unless ``DISPATCHES_TPU_OBS`` is set (or
+:func:`enable` was called), ``span()`` returns a shared no-op span and
+``instant()`` returns immediately — one cached boolean check per call
+site, no allocation, no locking.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from dispatches_tpu.analysis.flags import flag_enabled, flag_name
+
+__all__ = [
+    "enabled",
+    "enable",
+    "span",
+    "instant",
+    "events",
+    "reset",
+    "export_chrome_trace",
+    "to_chrome_events",
+]
+
+DEFAULT_BUFFER = 65536
+
+_lock = threading.Lock()
+_ENABLED: Optional[bool] = None     # lazily resolved from the env flag
+_BUFFER: Optional[Deque[Dict]] = None
+_DROPPED = 0
+
+# name stack of the spans currently open in this context (tuple of
+# span names; immutable so concurrent contexts never share state)
+_STACK: contextvars.ContextVar = contextvars.ContextVar(
+    "dispatches_tpu_obs_span_stack", default=()
+)
+
+
+def enabled() -> bool:
+    """Whether spans/instants are recorded (``DISPATCHES_TPU_OBS``).
+
+    The env flag is read once, lazily; :func:`enable` overrides it for
+    the rest of the process (tests, embedding drivers)."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = flag_enabled("OBS")
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def _buffer_size() -> int:
+    raw = os.environ.get(flag_name("OBS_BUFFER"), "")
+    return int(raw) if raw else DEFAULT_BUFFER
+
+
+def _buffer() -> Deque[Dict]:
+    global _BUFFER
+    if _BUFFER is None:
+        with _lock:
+            if _BUFFER is None:
+                _BUFFER = deque(maxlen=_buffer_size())
+    return _BUFFER
+
+
+def _record(event: Dict) -> None:
+    global _DROPPED
+    buf = _buffer()
+    with _lock:
+        if len(buf) == buf.maxlen:
+            _DROPPED += 1
+        buf.append(event)
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1e3
+
+
+class Span:
+    """One live span; use via ``with span("name", key=val) as sp:``."""
+
+    __slots__ = ("name", "args", "_t0", "_token")
+
+    def __init__(self, name: str, args: Dict):
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+        self._token = None
+
+    def fence(self, value):
+        """Block until the device work producing ``value`` (any pytree
+        of JAX arrays) has completed, then return it.  The fence runs
+        unconditionally — see the module docstring."""
+        import jax
+
+        return jax.block_until_ready(value)
+
+    def __enter__(self) -> "Span":
+        stack = _STACK.get()
+        self._token = _STACK.set(stack + (self.name,))
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        end = _now_us()
+        stack = _STACK.get()
+        parent = stack[-2] if len(stack) >= 2 else None
+        _STACK.reset(self._token)
+        args = dict(self.args)
+        if parent is not None:
+            args["parent"] = parent
+        _record({
+            "name": self.name,
+            "ph": "X",
+            "ts": self._t0,
+            "dur": end - self._t0,
+            "tid": threading.get_ident(),
+            "args": args,
+        })
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span returned when tracing is disabled.  ``fence``
+    still blocks (timing correctness is not telemetry)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    @staticmethod
+    def fence(value):
+        import jax
+
+        return jax.block_until_ready(value)
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **args):
+    """Context manager timing one operation; near-zero cost when
+    tracing is disabled."""
+    if not enabled():
+        return _NULL_SPAN
+    return Span(name, args)
+
+
+def instant(name: str, **args) -> None:
+    """Point event (e.g. a ``graft_jit`` compile)."""
+    if not enabled():
+        return
+    _record({
+        "name": name,
+        "ph": "i",
+        "ts": _now_us(),
+        "s": "t",
+        "tid": threading.get_ident(),
+        "args": args,
+    })
+
+
+def events() -> List[Dict]:
+    """Snapshot of the ring buffer (oldest first)."""
+    if _BUFFER is None:
+        return []
+    with _lock:
+        return list(_BUFFER)
+
+
+def dropped() -> int:
+    """Events evicted from the ring buffer so far."""
+    return _DROPPED
+
+
+def reset() -> None:
+    """Clear the buffer and re-resolve its size from the environment."""
+    global _BUFFER, _DROPPED
+    with _lock:
+        _BUFFER = None
+        _DROPPED = 0
+
+
+def to_chrome_events(evts: Optional[List[Dict]] = None) -> List[Dict]:
+    """Chrome trace-event dicts (``ph:X`` complete spans, ``ph:i``
+    instants) for ``evts`` (default: the live buffer)."""
+    pid = os.getpid()
+    out = []
+    for e in (events() if evts is None else evts):
+        ce = dict(e)
+        ce["pid"] = pid
+        ce["cat"] = "dispatches_tpu"
+        out.append(ce)
+    return out
+
+
+def export_chrome_trace(path, evts: Optional[List[Dict]] = None) -> int:
+    """Write the buffered events as Chrome trace-event JSON (Perfetto /
+    ``chrome://tracing`` compatible); returns the event count."""
+    chrome = to_chrome_events(evts)
+    payload = {"traceEvents": chrome, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return len(chrome)

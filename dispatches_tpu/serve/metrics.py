@@ -1,66 +1,120 @@
 """Service telemetry: per-bucket counters, latency quantiles, and the
 ``--stats`` text report.
 
-Everything here is plain host-side bookkeeping (no JAX): the service
-records events as they happen and :func:`format_stats` renders the
-metrics dict the way the reference's solver logs render iteration
-tables — a fixed-width text block an operator can tail.
+Everything here is plain host-side bookkeeping (no JAX).  The
+instruments are the obs-layer ones (``dispatches_tpu.obs.registry``):
+:class:`LatencyWindow` is a sliding-window :class:`~dispatches_tpu.obs.
+registry.Histogram` and :class:`BucketStats` rides on a labeled
+:class:`~dispatches_tpu.obs.registry.Counter` — both **instance-scoped**
+(constructed directly, not through the process registry) so two
+services never blend their ``--stats``.  The service mirrors its
+aggregate events into the process-wide default registry separately;
+:func:`format_stats` renders the metrics dict the way the reference's
+solver logs render iteration tables — a fixed-width text block an
+operator can tail, byte-for-byte what it printed before the rebase.
 """
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Dict, List, Optional
 
+from dispatches_tpu.obs.registry import Counter, Histogram
 
-class LatencyWindow:
-    """Sliding window of request latencies (ms) with cheap quantiles."""
+
+class LatencyWindow(Histogram):
+    """Sliding window of request latencies (ms) with cheap quantiles.
+
+    A single-series (unlabeled) histogram with the serve layer's
+    historical ``_ms``-suffixed summary keys."""
 
     def __init__(self, maxlen: int = 4096):
-        self._window = deque(maxlen=maxlen)
-        self.count = 0
-        self.total_ms = 0.0
+        super().__init__("serve.latency_ms", "per-request solve latency",
+                         window=maxlen)
+        # single-series histogram: bind the unlabeled window once so the
+        # per-request record() skips label resolution
+        with self._lock:
+            self._w0 = self._window({})
 
     def record(self, latency_ms: float) -> None:
-        self._window.append(float(latency_ms))
-        self.count += 1
-        self.total_ms += float(latency_ms)
+        with self._lock:
+            self._w0.observe(float(latency_ms))
 
-    def quantile(self, q: float) -> Optional[float]:
-        if not self._window:
-            return None
-        xs = sorted(self._window)
-        idx = min(int(q * len(xs)), len(xs) - 1)
-        return xs[idx]
+    @property
+    def count(self) -> int:  # was a plain attribute pre-rebase
+        return Histogram.count(self)
+
+    @property
+    def total_ms(self) -> float:
+        return Histogram.total(self)
 
     def summary(self) -> Dict[str, float]:
-        out = {"count": self.count}
-        if self._window:
-            out["mean_ms"] = round(self.total_ms / max(self.count, 1), 3)
-            out["p50_ms"] = round(self.quantile(0.50), 3)
-            out["p99_ms"] = round(self.quantile(0.99), 3)
+        s = Histogram.summary(self)
+        out = {"count": s["count"]}
+        if "mean" in s:
+            out["mean_ms"] = s["mean"]
+            out["p50_ms"] = s["p50"]
+            out["p99_ms"] = s["p99"]
         return out
 
 
 class BucketStats:
-    """Counters for one shape bucket."""
+    """Counters for one shape bucket (Counter-backed, label ``event=``)."""
 
     def __init__(self, label: str):
         self.label = label
-        self.submitted = 0
-        self.solved = 0
-        self.timeouts = 0
-        self.batches = 0
-        self.lanes_dispatched = 0   # padded lanes summed over batches
-        self.live_dispatched = 0    # real (unpadded) requests dispatched
+        self._events = Counter(f"serve.bucket[{label}]",
+                               "per-bucket request/batch events")
+        # bound per-event cells: the submit/solve path is per-request,
+        # so skip the label formatting Counter.inc would redo each call
+        self._cells = {event: self._events.labeled(event=event)
+                       for event in ("submitted", "solved", "timeout",
+                                     "batch", "live", "lanes")}
         self.lane_counts: List[int] = []  # distinct padded widths seen
 
+    def _count(self, event: str) -> int:
+        return int(self._cells[event].value())
+
+    def record_submitted(self) -> None:
+        self._cells["submitted"].inc()
+
+    def record_solved(self) -> None:
+        self._cells["solved"].inc()
+
+    def record_timeout(self) -> None:
+        self._cells["timeout"].inc()
+
     def record_batch(self, n_live: int, lanes: int) -> None:
-        self.batches += 1
-        self.live_dispatched += n_live
-        self.lanes_dispatched += lanes
+        self._cells["batch"].inc()
+        self._cells["live"].inc(n_live)
+        self._cells["lanes"].inc(lanes)
         if lanes not in self.lane_counts:
             self.lane_counts.append(lanes)
+
+    @property
+    def submitted(self) -> int:
+        return self._count("submitted")
+
+    @property
+    def solved(self) -> int:
+        return self._count("solved")
+
+    @property
+    def timeouts(self) -> int:
+        return self._count("timeout")
+
+    @property
+    def batches(self) -> int:
+        return self._count("batch")
+
+    @property
+    def live_dispatched(self) -> int:
+        """Real (unpadded) requests dispatched."""
+        return self._count("live")
+
+    @property
+    def lanes_dispatched(self) -> int:
+        """Padded lanes summed over batches."""
+        return self._count("lanes")
 
     @property
     def occupancy(self) -> Optional[float]:

@@ -49,6 +49,8 @@ import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.obs import registry as obs_registry
+from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import (
     freeze_options,
     pad_lanes,
@@ -266,6 +268,15 @@ class SolveService:
         self._solved = 0
         self._timeouts = 0
         self._flushes = 0
+        # process-wide mirrors (dispatches_tpu.obs) — the per-service
+        # numbers above stay authoritative for format_stats()
+        _requests = obs_registry.counter(
+            "serve.requests", "solve-service request events")
+        self._obs_submitted = _requests.labeled(event="submitted")
+        self._obs_solved = _requests.labeled(event="solved")
+        self._obs_timeout = _requests.labeled(event="timeout")
+        self._obs_batches = obs_registry.counter(
+            "serve.batches", "solve-service batches dispatched")
 
     # -- bucket resolution -------------------------------------------------
 
@@ -328,8 +339,9 @@ class SolveService:
             handle.x0 = np.asarray(
                 bucket.default_x0 if x0 is None else x0)
         bucket.pending.append(handle)
-        bucket.stats.submitted += 1
+        bucket.stats.record_submitted()
         self._submitted += 1
+        self._obs_submitted.inc()
         if len(bucket.pending) >= self.options.max_batch:
             self._flush_bucket(bucket)
         return handle
@@ -406,8 +418,9 @@ class SolveService:
                 r._complete(ServeResult(
                     RequestStatus.TIMEOUT, None, None,
                     (now - r.submitted_at) * 1e3))
-                bucket.stats.timeouts += 1
+                bucket.stats.record_timeout()
                 self._timeouts += 1
+                self._obs_timeout.inc()
             else:
                 live.append(r)
         if not live:
@@ -430,12 +443,17 @@ class SolveService:
                 lambda a: jax.device_put(a, shard), batched)
             if bucket.kind == "ipm":
                 x0_stack = jax.device_put(x0_stack, shard)
-        if bucket.kind == "ipm":
-            res = bucket.run(batched, x0_stack)
-        else:
-            res = bucket.run(batched)
-        res = jax.block_until_ready(res)
+        with obs_trace.span("serve.batch", bucket=bucket.stats.label,
+                            lanes=lanes, live=len(live)) as sp:
+            if bucket.kind == "ipm":
+                res = bucket.run(batched, x0_stack)
+            else:
+                res = bucket.run(batched)
+            # sp.fence == jax.block_until_ready, span or no span: batch
+            # latency must cover device completion
+            res = sp.fence(res)
         bucket.stats.record_batch(len(live), lanes)
+        self._obs_batches.inc(bucket=bucket.stats.label)
         end = self._clock()
         objs = np.asarray(res.obj)
         for i, r in enumerate(live):
@@ -444,10 +462,11 @@ class SolveService:
             r._complete(ServeResult(
                 RequestStatus.DONE, lane, float(objs[i]), latency))
             self._latency.record(latency)
-            bucket.stats.solved += 1
+            bucket.stats.record_solved()
             self._solved += 1
             if bucket.kind == "ipm" and self.options.warm_start:
                 self._warm.put(r.warm_key, bucket.nlp, lane)
+        self._obs_solved.inc(len(live))
         return n
 
     # -- telemetry ---------------------------------------------------------

@@ -79,9 +79,17 @@ def _linear_solve_refined(J, r):
     return x
 
 
-def make_newton_solver(nlp, options: Optional[NewtonOptions] = None):
+def make_newton_solver(nlp, options: Optional[NewtonOptions] = None,
+                       trace: bool = False):
     """Compile a square-system Newton solver for a CompiledNLP with no
-    inequalities.  Returns ``solver(params, x0=None) -> NewtonResult``."""
+    inequalities.  Returns ``solver(params, x0=None) -> NewtonResult``.
+
+    ``trace=True`` returns ``(NewtonResult, trace_dict)`` where
+    ``trace_dict["max_residual"]`` has fixed length ``max_iter`` (one
+    entry per damped step; finished lanes hold their last value),
+    captured on-device by a fixed-length ``lax.scan`` — decode with
+    ``obs.solverlog.decode_newton``.  The step arithmetic is unchanged,
+    so traced and untraced solves are bitwise-identical."""
     opt = options or NewtonOptions()
 
     probe = nlp.eq(jnp.asarray(nlp.x0), nlp.default_params())
@@ -162,15 +170,24 @@ def make_newton_solver(nlp, options: Optional[NewtonOptions] = None):
             _, it, err = state
             return (err > opt.tol) & (it < opt.max_iter)
 
-        x1, it, err = jax.lax.while_loop(
-            cond, body, (x, jnp.asarray(0), jnp.asarray(jnp.inf))
-        )
-        return NewtonResult(
+        state0 = (x, jnp.asarray(0), jnp.asarray(jnp.inf))
+        if trace:
+            def scan_body(state, _):
+                state2 = jax.lax.cond(cond(state), body, lambda s: s, state)
+                return state2, {"max_residual": state2[2]}
+
+            (x1, it, err), trace_rec = jax.lax.scan(
+                scan_body, state0, None, length=opt.max_iter
+            )
+        else:
+            x1, it, err = jax.lax.while_loop(cond, body, state0)
+        result = NewtonResult(
             x=x1,
             converged=err <= opt.tol,
             iterations=it,
             max_residual=err,
         )
+        return (result, trace_rec) if trace else result
 
     return solver
 

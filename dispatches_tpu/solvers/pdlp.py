@@ -143,13 +143,24 @@ def make_lp_data(nlp, probe_params=None):
     return {"K": K, "G": G, "lb": np.asarray(nlp.lb), "ub": np.asarray(nlp.ub)}
 
 
-def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
+def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
+                     trace: bool = False):
     """Build ``solver(params) -> LPResult`` for an affine CompiledNLP.
 
     The returned callable is jit/vmap-compatible; Jacobian structure is
     baked in, per-scenario ``c``/``q``/``h`` are re-derived from
     ``params`` inside the trace (cheap: one residual eval at x=0 plus
-    one objective gradient)."""
+    one objective gradient).
+
+    ``trace=True`` returns ``(LPResult, trace_dict)`` where
+    ``trace_dict`` holds one row per termination check (fixed length
+    ``ceil(max_iter / check_every)``; finished lanes hold state):
+    ``it``, candidate KKT ``err``, ``err_best``, and the best-iterate
+    components ``pr`` / ``du`` / ``gap``.  Captured on-device by a
+    fixed-length ``lax.scan`` — no host callbacks in the hot loop;
+    decode with ``obs.solverlog.decode_pdlp``.  The iterate arithmetic
+    is unchanged, so traced and untraced solves return bitwise-identical
+    solutions."""
     opt = options
     if opt.polish and not jax.config.jax_enable_x64:
         warnings.warn(
@@ -319,7 +330,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             pr, du, gap = _kkt_errors(x_, z_, c, b)
             return jnp.maximum(jnp.maximum(pr, du), gap), (pr, du, gap)
 
-        e0, _ = err_of(x, z)
+        e0, k0 = err_of(x, z)
 
         def cond(s):
             return jnp.logical_and(s["it"] < opt.max_iter, ~s["done"])
@@ -331,8 +342,8 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             nan_guard("pdlp.iterate", x1, z1)
             k = s["k"] + opt.check_every
             xa, za = xs / k, zs / k
-            e_cur, _ = err_of(x1, z1)
-            e_avg, _ = err_of(xa, za)
+            e_cur, k_cur = err_of(x1, z1)
+            e_avg, k_avg = err_of(xa, za)
             use_avg = e_avg < e_cur
             xc = jnp.where(use_avg, xa, x1)
             zc = jnp.where(use_avg, za, z1)
@@ -394,7 +405,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             done = jnp.logical_or(
                 s["done"], jnp.logical_or(e_b < opt.tol, floored)
             )
-            return {
+            out = {
                 "x": x_next,
                 "z": z_next,
                 "xs": jnp.where(do_restart, zero_x, xs),
@@ -411,6 +422,18 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
                 "xb": xb,
                 "zb": zb,
             }
+            if trace:
+                # best-iterate KKT components, carried only when tracing
+                # (extra state never feeds the iterate math above, so
+                # traced solves stay bitwise-identical to untraced)
+                pr_c = jnp.where(use_avg, k_avg[0], k_cur[0])
+                du_c = jnp.where(use_avg, k_avg[1], k_cur[1])
+                gap_c = jnp.where(use_avg, k_avg[2], k_cur[2])
+                out["e_c"] = e_c
+                out["pr_b"] = jnp.where(new_best, pr_c, s["pr_b"])
+                out["du_b"] = jnp.where(new_best, du_c, s["du_b"])
+                out["gap_b"] = jnp.where(new_best, gap_c, s["gap_b"])
+            return out
 
         init = {
             "x": x,
@@ -429,7 +452,28 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             "xb": x,
             "zb": z,
         }
-        out = jax.lax.while_loop(cond, step, init)
+        if trace:
+            init.update({"e_c": e0, "pr_b": k0[0], "du_b": k0[1],
+                         "gap_b": k0[2]})
+
+            def scan_body(s, _):
+                s2 = jax.lax.cond(cond(s), step, lambda t: t, s)
+                rec = {
+                    "it": s2["it"],
+                    "err": s2["e_c"],
+                    "err_best": s2["e_b"],
+                    "pr": s2["pr_b"],
+                    "du": s2["du_b"],
+                    "gap": s2["gap_b"],
+                }
+                return s2, rec
+
+            n_checks = -(-opt.max_iter // opt.check_every)
+            out, trace_rec = jax.lax.scan(
+                scan_body, init, None, length=n_checks
+            )
+        else:
+            out = jax.lax.while_loop(cond, step, init)
         xb, zb = out["xb"], out["zb"]
         pr, du, gap = _kkt_errors(xb, zb, c, b)
         x_scaled = xb * dc_j  # back to the CompiledNLP's scaled space
@@ -451,7 +495,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
         # evaluate the model objective directly (keeps any constant term
         # that c'x misses, and the user's declared sense)
         obj = nlp.user_objective(x_obj, params)
-        return LPResult(
+        result = LPResult(
             x=x_scaled,
             obj=obj,
             converged=jnp.maximum(jnp.maximum(pr, du), gap) < opt.tol,
@@ -461,5 +505,6 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None):
             gap=gap,
             z=zb * dr_j,
         )
+        return (result, trace_rec) if trace else result
 
     return solver

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import pad_lanes, request_fingerprint
 from dispatches_tpu.sweep.spec import SweepSpec
 from dispatches_tpu.sweep.store import (
@@ -190,22 +191,23 @@ def run_sweep(nlp, spec: SweepSpec, *,
         values = spec.values_for(idxs)
         n_live = len(idxs)
         t0 = time.perf_counter()
-        obj, conv, iters = solve_chunk(values, n_live)
-        status = np.zeros(n_live, dtype=np.int8)
-        retries = np.zeros(n_live, dtype=np.int16)
-        for j in np.where(~np.isfinite(obj))[0]:
-            for attempt in range(1, opts.max_retries + 1):
-                single = {k: np.asarray(v)[j:j + 1]
-                          for k, v in values.items()}
-                o1, c1, i1 = solve_chunk(single, 1)
-                retries[j] = attempt
-                if np.isfinite(o1[0]):
-                    obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
-                    status[j] = STATUS_RETRIED
-                    break
-            else:
-                status[j] = STATUS_QUARANTINED
-                conv[j] = False
+        with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
+            obj, conv, iters = solve_chunk(values, n_live)
+            status = np.zeros(n_live, dtype=np.int8)
+            retries = np.zeros(n_live, dtype=np.int16)
+            for j in np.where(~np.isfinite(obj))[0]:
+                for attempt in range(1, opts.max_retries + 1):
+                    single = {k: np.asarray(v)[j:j + 1]
+                              for k, v in values.items()}
+                    o1, c1, i1 = solve_chunk(single, 1)
+                    retries[j] = attempt
+                    if np.isfinite(o1[0]):
+                        obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
+                        status[j] = STATUS_RETRIED
+                        break
+                else:
+                    status[j] = STATUS_QUARANTINED
+                    conv[j] = False
         store.record_chunk(cid, {
             "index": idxs.astype(np.int64),
             "obj": obj,
@@ -245,7 +247,10 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                     p[k] = jnp.asarray(v)
                 else:
                     f[k] = jnp.asarray(v)
-            return _extract(vrun({"p": p, "fixed": f}), n_live)
+            # fence before _extract so the chunk timer upstream measures
+            # device completion, not async dispatch (points/s honesty)
+            return _extract(
+                jax.block_until_ready(vrun({"p": p, "fixed": f})), n_live)
 
         return solve_chunk
 
@@ -263,8 +268,9 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             solver=base, full_result=True)
 
         def solve_chunk(values, n_live):
-            # the sharded solver pads to the mesh and strips internally
-            return _extract(sharded(values), n_live)
+            # the sharded solver pads to the mesh and strips internally;
+            # fence for the same timing honesty as the direct backend
+            return _extract(jax.block_until_ready(sharded(values)), n_live)
 
         return solve_chunk
 

@@ -270,13 +270,34 @@ def test_day_parallel_smoke_one_day(tmp_path, case):
     day with ``da_bid_window=2`` runs the ``prefetch_da_bids`` ->
     batched ``compute_day_ahead_bids_batch`` -> ``request_da_bids`` pop
     path end to end (the window clamps to the one remaining day), with
-    finite dispatch and one recorded DA bid set per horizon hour."""
+    finite dispatch and one recorded DA bid set per horizon hour.
+
+    The run doubles as the obs acceptance check on the real dataset:
+    with tracing on, the exported Chrome trace carries the RUC span,
+    24 SCED spans, and at least one compile instant."""
+    from dispatches_tpu.obs import report, trace
+
     rng = np.random.default_rng(11)
     cfs = 0.3 + 0.4 * rng.random(24 * 3)
     hist = list(20.0 + 10.0 * rng.random(24))
 
     sim = _build_wind_battery_cosim(case, tmp_path / "dl_smoke", cfs, hist)
-    out = sim.simulate(start_date="2020-07-10", num_days=1, da_bid_window=2)
+    trace.enable(True)
+    trace.reset()
+    try:
+        out = sim.simulate(start_date="2020-07-10", num_days=1,
+                           da_bid_window=2)
+        trace_path = tmp_path / "dl_smoke_trace.json"
+        trace.export_chrome_trace(trace_path)
+    finally:
+        trace.enable(False)
+        trace.reset()
+    evts = report.load_chrome_trace(trace_path)
+    names = [e["name"] for e in evts]
+    assert "market.ruc" in names
+    assert names.count("market.sced") == 24
+    assert any(e["name"] == "compile" and e["ph"] == "i" for e in evts)
+    assert report.aggregate_spans(evts)["market.sced"]["count"] == 24
 
     coord = sim.coordinator
     # the prefetch cache was populated by the batched solve and drained

@@ -1,0 +1,457 @@
+"""Observability-layer tests: metrics registry semantics, span tracer
+(nesting, ring bound, Chrome export, disabled fast path), the serve
+``--stats`` golden (byte-identical after the registry rebase), solver
+convergence traces (IPM / PDLP / Newton) with bitwise on/off parity,
+and the ``python -m dispatches_tpu.obs`` CLI."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.obs import registry as reg
+from dispatches_tpu.obs import report, solverlog, trace
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "serve_stats_golden.txt")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts with tracing off and an empty buffer."""
+    trace.enable(False)
+    trace.reset()
+    yield
+    trace.enable(False)
+    trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_and_total():
+    c = reg.Counter("req")
+    c.inc(event="ok")
+    c.inc(2, event="ok")
+    c.inc(event="err")
+    assert c.value(event="ok") == 3
+    assert c.value(event="err") == 1
+    assert c.value(event="missing") == 0
+    assert c.total() == 4
+    assert c.snapshot() == {"event=ok": 3, "event=err": 1}
+
+
+def test_gauge_set_and_inc():
+    g = reg.Gauge("depth")
+    assert g.value() is None
+    g.set(5)
+    g.inc(-2)
+    assert g.value() == 3
+
+
+def test_histogram_window_and_quantiles():
+    h = reg.Histogram("lat", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        h.observe(v)
+    # count/total are lifetime; quantiles are window-scoped (2..5)
+    assert h.count() == 5
+    assert h.quantile(0.0) == 2.0
+    assert h.quantile(0.99) == 5.0
+    s = h.summary()
+    assert s["count"] == 5 and "mean" in s and "p50" in s and "p99" in s
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    r = reg.MetricsRegistry()
+    c1 = r.counter("a")
+    assert r.counter("a") is c1
+    with pytest.raises(TypeError, match="already registered"):
+        r.gauge("a")
+
+
+def test_snapshot_diff():
+    r = reg.MetricsRegistry()
+    c = r.counter("events")
+    h = r.histogram("lat")
+    c.inc(kind="x")
+    h.observe(1.0)
+    before = r.snapshot()
+    c.inc(kind="x")
+    c.inc(kind="y")
+    h.observe(2.0)
+    d = reg.diff_snapshots(before, r.snapshot())
+    assert d["events"]["delta"] == {"kind=x": 1, "kind=y": 1}
+    assert d["lat"]["delta"] == {"": 1}
+    assert reg.diff_snapshots(r.snapshot(), r.snapshot()) == {}
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent():
+    trace.enable(True)
+    with trace.span("outer"):
+        with trace.span("inner") as sp:
+            sp.fence(np.arange(3))
+    evts = trace.events()
+    assert [e["name"] for e in evts] == ["inner", "outer"]
+    assert evts[0]["args"]["parent"] == "outer"
+    assert "parent" not in evts[1]["args"]
+    assert evts[0]["ph"] == "X" and evts[0]["dur"] >= 0
+
+
+def test_disabled_fast_path_is_shared_null_span():
+    from dispatches_tpu.obs.trace import _NULL_SPAN
+
+    assert trace.span("anything") is _NULL_SPAN
+    trace.instant("nothing")
+    assert trace.events() == []
+    # fence still blocks (timing correctness is not telemetry)
+    out = _NULL_SPAN.fence(jax.numpy.arange(3))
+    assert np.asarray(out).tolist() == [0, 1, 2]
+
+
+def test_ring_buffer_bound_and_dropped(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_BUFFER", "4")
+    trace.reset()  # re-resolve the buffer size from the env
+    trace.enable(True)
+    for i in range(10):
+        trace.instant("tick", i=i)
+    evts = trace.events()
+    assert len(evts) == 4
+    assert [e["args"]["i"] for e in evts] == [6, 7, 8, 9]
+    assert trace.dropped() == 6
+
+
+def test_chrome_export_schema(tmp_path):
+    trace.enable(True)
+    with trace.span("work", tag="a"):
+        pass
+    trace.instant("compile", label="k")
+    path = tmp_path / "trace.json"
+    n = trace.export_chrome_trace(path)
+    assert n == 2
+    payload = json.loads(path.read_text())
+    evts = payload["traceEvents"]
+    span_evt = next(e for e in evts if e["name"] == "work")
+    inst_evt = next(e for e in evts if e["name"] == "compile")
+    assert span_evt["ph"] == "X"
+    for key in ("ts", "dur", "pid", "tid"):
+        assert key in span_evt
+    assert inst_evt["ph"] == "i" and inst_evt["s"] == "t"
+    assert report.load_chrome_trace(path) == evts
+
+
+def test_report_aggregates_spans_and_instants():
+    trace.enable(True)
+    for _ in range(3):
+        with trace.span("solve"):
+            pass
+    trace.instant("compile", label="k")
+    agg = report.aggregate_spans(trace.events())
+    assert agg["solve"]["count"] == 3
+    assert agg["solve"]["total_ms"] >= 0
+    assert agg["compile"] == {"count": 1}
+    text = report.format_report(trace.events())
+    assert "solve" in text and "compile" in text
+
+
+# ---------------------------------------------------------------------------
+# serve --stats golden (registry rebase must be byte-invisible)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_stats_golden_byte_identical():
+    from dispatches_tpu.serve import ServeOptions, SolveService
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+
+    ticks = {"t": 0.0}
+
+    def clock():
+        ticks["t"] += 0.25e-3
+        return ticks["t"]
+
+    service = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9),
+                           clock=clock)
+    nlp = _arbitrage_nlp(6)
+    defaults = nlp.default_params()
+    rng = np.random.default_rng(0)
+    handles = []
+    for _ in range(6):
+        price = 30.0 + 10.0 * rng.standard_normal(6)
+        params = {"p": {**defaults["p"], "price": price},
+                  "fixed": defaults["fixed"]}
+        handles.append(service.submit(nlp, params, solver="pdlp"))
+    service.flush_all()
+    assert all(h.result().status == "DONE" for h in handles)
+
+    with open(GOLDEN, "rb") as f:
+        golden = f.read()
+    assert (service.format_stats() + "\n").encode() == golden
+
+
+# ---------------------------------------------------------------------------
+# solver convergence traces
+# ---------------------------------------------------------------------------
+
+
+def _ref_qp():
+    # min (x-1)^2 + (y-2)^2 s.t. x + y = 2 -> (0.5, 1.5)
+    fs = Flowsheet()
+    fs.add_var("x", shape=())
+    fs.add_var("y", shape=())
+    fs.add_eq("bal", lambda v, p: v["x"] + v["y"] - 2.0)
+    return fs.compile(
+        objective=lambda v, p: (v["x"] - 1.0) ** 2 + (v["y"] - 2.0) ** 2)
+
+
+def test_ipm_trace_mu_monotone_and_bitwise_parity():
+    from dispatches_tpu.solvers import make_ipm_solver
+
+    nlp = _ref_qp()
+    params = nlp.default_params()
+    res0 = jax.jit(make_ipm_solver(nlp))(params)
+    res1, tr = jax.jit(make_ipm_solver(nlp, trace=True))(params)
+
+    assert np.asarray(res0.x).tobytes() == np.asarray(res1.x).tobytes()
+    ct = solverlog.decode_ipm(tr, res1)
+    assert ct.solver == "ipm" and len(ct) == int(res1.iterations)
+    mu = ct["mu"]
+    assert np.all(np.diff(mu) <= 0.0), f"barrier mu not monotone: {mu}"
+    assert mu[-1] < mu[0]
+    # decode trims the finished-lane tail
+    assert len(mu) <= len(np.asarray(tr["mu"]))
+    assert "kkt_error" in ct.columns and "iter" in ct.format()
+
+
+def test_pdlp_trace_gap_at_reported_iteration_and_parity():
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+    from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+
+    nlp = _arbitrage_nlp(6)
+    params = nlp.default_params()
+    opts = PDLPOptions(dtype="float64", tol=1e-8)
+    res0 = jax.jit(make_pdlp_solver(nlp, opts))(params)
+    res1, tr = jax.jit(make_pdlp_solver(nlp, opts, trace=True))(params)
+
+    assert np.asarray(res0.x).tobytes() == np.asarray(res1.x).tobytes()
+    assert bool(res1.converged)
+    ct = solverlog.decode_pdlp(tr, res1)
+    assert int(ct["it"][-1]) == int(res1.iters)
+    # the trace's best-iterate components at the reported iteration are
+    # exactly what the LPResult certifies
+    assert float(ct["gap"][-1]) == float(res1.gap)
+    assert float(ct["gap"][-1]) <= opts.tol
+    assert float(ct["err_best"][-1]) <= opts.tol
+
+
+def test_newton_trace_residual_and_parity():
+    from dispatches_tpu.solvers.newton import make_newton_solver
+
+    fs = Flowsheet()
+    fs.add_var("x", shape=(), init=2.0)
+    fs.add_eq("e", lambda v, p: v["x"] ** 2 - 2.0)
+    nlp = fs.compile()
+    params = nlp.default_params()
+    res0 = jax.jit(make_newton_solver(nlp))(params)
+    res1, tr = jax.jit(make_newton_solver(nlp, trace=True))(params)
+
+    assert np.asarray(res0.x).tobytes() == np.asarray(res1.x).tobytes()
+    ct = solverlog.decode_newton(tr, res1)
+    r = ct["max_residual"]
+    assert len(r) == int(res1.iterations)
+    assert np.all(np.diff(r) < 0)  # quadratic convergence on sqrt(2)
+    assert r[-1] == float(res1.max_residual)
+
+
+# ---------------------------------------------------------------------------
+# compile instants + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_graft_jit_emits_compile_instant():
+    from dispatches_tpu.analysis.runtime import graft_jit
+
+    trace.enable(True)
+    before = reg.counter("graft.compiles").value(label="obs.test.add")
+    f = graft_jit(lambda a: a + 1, label="obs.test.add")
+    f(np.float64(1.0))
+    f(np.float64(2.0))  # cache hit: no second compile event
+    compiles = [e for e in trace.events()
+                if e["name"] == "compile"
+                and e["args"].get("label") == "obs.test.add"]
+    assert len(compiles) == 1
+    assert reg.counter("graft.compiles").value(
+        label="obs.test.add") == before + 1
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DISPATCHES_TPU_SLOW"),
+    reason="full 1-day double-loop co-simulation on a synthetic 2-bus "
+    "case (~1 min single-core); set DISPATCHES_TPU_SLOW=1 to run",
+)
+def test_acceptance_double_loop_trace_export(tmp_path):
+    """ISSUE 4 acceptance: with tracing enabled, a 1-day double-loop
+    run (plus a small serve workload) exports a Chrome trace containing
+    the RUC span, 24 SCED spans, serve batch spans, and at least one
+    compile event — and the report CLI aggregates them."""
+    import pandas as pd
+
+    from dispatches_tpu.case_studies.renewables.wind_battery_double_loop import (
+        MultiPeriodWindBattery,
+    )
+    from dispatches_tpu.grid import (
+        RenewableGeneratorModelData,
+        SelfScheduler,
+        Tracker,
+    )
+    from dispatches_tpu.grid.coordinator import DoubleLoopCoordinator
+    from dispatches_tpu.grid.market import (
+        MarketCase,
+        MarketSimulator,
+        RenewableUnit,
+        ThermalUnit,
+    )
+    from dispatches_tpu.serve import ServeOptions, SolveService
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+
+    rng = np.random.default_rng(3)
+    n_hours = 48
+    hours = np.arange(n_hours)
+    load1 = 80.0 + 20.0 * np.sin(2 * np.pi * hours / 24.0)
+    load2 = np.full(n_hours, 40.0)
+    case = MarketCase(
+        buses=["1", "2"],
+        thermals=[ThermalUnit(
+            name="1_STEAM", bus="1", pmin=20.0, pmax=220.0,
+            ramp_hr=220.0, min_up=1.0, min_down=1.0, startup_cost=100.0,
+            noload_cost=100.0, seg_mw=np.array([70.0, 70.0, 60.0]),
+            seg_cost=np.array([20.0, 26.0, 34.0]), initial_on=True,
+            initial_p=100.0,
+        )],
+        renewables=[RenewableUnit(
+            name="2_PV", bus="2",
+            da_cap=10.0 + 5.0 * rng.random(n_hours),
+            rt_cap=10.0 + 5.0 * rng.random(n_hours),
+        )],
+        load_da=np.column_stack([load1, load2]),
+        load_rt=np.column_stack([load1 * 1.02, load2]),
+        ptdf=np.array([[0.5, -0.5]]),
+        line_limits=np.array([1e3]),
+        line_names=["L1"],
+        start_timestamp=pd.Timestamp("2020-01-01"),
+    )
+
+    class _StaticForecaster:
+        def __init__(self, prices24):
+            self._p = np.asarray(prices24, float)
+
+        def _tile(self, horizon, n):
+            reps = int(np.ceil(horizon / len(self._p)))
+            return np.tile(np.tile(self._p, reps)[:horizon], (n, 1))
+
+        def forecast_day_ahead_prices(self, date, hour, bus, horizon, n):
+            return self._tile(horizon, n)
+
+        def forecast_real_time_prices(self, date, hour, bus, horizon, n):
+            return self._tile(horizon, n)
+
+    md = RenewableGeneratorModelData(
+        gen_name="1_WIND", bus="1", p_min=0.0, p_max=60.0
+    )
+    cfs = 0.3 + 0.4 * rng.random(24 * 2)
+
+    def mp():
+        return MultiPeriodWindBattery(
+            model_data=md, wind_capacity_factors=cfs, wind_pmax_mw=60,
+            battery_pmax_mw=10, battery_energy_capacity_mwh=40,
+        )
+
+    bidder = SelfScheduler(
+        bidding_model_object=mp(), day_ahead_horizon=24,
+        real_time_horizon=4, n_scenario=1,
+        forecaster=_StaticForecaster(list(20.0 + 10.0 * rng.random(24))),
+        max_iter=150,
+    )
+    coord = DoubleLoopCoordinator(
+        bidder,
+        Tracker(tracking_model_object=mp(), tracking_horizon=4,
+                max_iter=150),
+        Tracker(tracking_model_object=mp(), tracking_horizon=4,
+                max_iter=150),
+    )
+
+    trace.enable(True)
+    trace.reset()
+    sim = MarketSimulator(
+        case, output_dir=tmp_path / "dl_obs", sced_horizon=1,
+        ruc_horizon=24, reserve_factor=0.0, coordinator=coord,
+    )
+    out = sim.simulate(start_date="2020-01-01", num_days=1)
+    th = pd.read_csv(out["output_dir"] / "thermal_detail.csv")
+    part = th[th.Generator == "1_WIND"]
+    assert len(part) == 24 and np.all(np.isfinite(part["Dispatch"]))
+
+    # a small serve workload in the same process contributes batch spans
+    service = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9))
+    nlp = _arbitrage_nlp(4)
+    defaults = nlp.default_params()
+    srng = np.random.default_rng(0)
+    hs = []
+    for _ in range(2):
+        price = 30.0 + 10.0 * srng.standard_normal(4)
+        hs.append(service.submit(
+            nlp,
+            {"p": {**defaults["p"], "price": price},
+             "fixed": defaults["fixed"]},
+            solver="pdlp",
+        ))
+    service.flush_all()
+    assert all(h.result().status == "DONE" for h in hs)
+
+    path = tmp_path / "double_loop_trace.json"
+    trace.export_chrome_trace(path)
+    evts = report.load_chrome_trace(path)
+    names = [e["name"] for e in evts]
+    assert "market.ruc" in names
+    assert names.count("market.sced") == 24
+    assert "serve.batch" in names
+    compiles = [e for e in evts if e["name"] == "compile" and e["ph"] == "i"]
+    assert len(compiles) >= 1
+    # nested bid/track spans carry the cycle parent
+    sced_children = [e for e in evts
+                     if e["args"].get("parent") == "market.sced"]
+    assert sced_children, "bid.rt/track.rt spans nest under market.sced"
+
+    agg = report.aggregate_spans(evts)
+    assert agg["market.sced"]["count"] == 24
+    assert agg["market.ruc"]["total_ms"] > 0
+    text = report.format_report(evts, dropped=trace.dropped())
+    assert "market.ruc" in text and "serve.batch" in text
+
+
+def test_obs_cli_report_json(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    out_trace = tmp_path / "t.json"
+    rc = main(["--report", "--json", "--export-trace", str(out_trace)])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["spans"]["serve.batch"]["count"] >= 1
+    assert payload["spans"]["compile"]["count"] >= 1
+    assert "serve.requests" in payload["metrics"]
+    evts = report.load_chrome_trace(out_trace)
+    assert any(e["name"] == "serve.batch" for e in evts)
+
+    rc = main(["--report", "--trace-file", str(out_trace)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert text.startswith("== dispatches_tpu.obs report ==")
+    assert "serve.batch" in text

@@ -165,6 +165,48 @@ def test_run_sweep_direct_matches_single_solves(nlp, ref_store):
         assert a["obj"][i] == pytest.approx(float(ref.obj), abs=1e-6)
 
 
+def test_chunk_timer_covers_device_completion(nlp, tmp_path, monkeypatch):
+    """Regression (obs PR): the chunk timer must stop only AFTER
+    jax.block_until_ready on the backend result — async dispatch used
+    to let the stop timestamp land before device completion, inflating
+    points/s."""
+    import time as time_mod
+
+    from dispatches_tpu.sweep import engine as engine_mod
+
+    events = []
+    real_perf = time_mod.perf_counter
+
+    class _TimeSpy:
+        @staticmethod
+        def perf_counter():
+            events.append("timer")
+            return real_perf()
+
+    real_fence = jax.block_until_ready
+
+    def _fence_spy(value):
+        events.append("fence")
+        return real_fence(value)
+
+    monkeypatch.setattr(engine_mod, "time", _TimeSpy)
+    monkeypatch.setattr(engine_mod.jax, "block_until_ready", _fence_spy)
+
+    spec = SweepSpec((grid("price",
+                           np.random.default_rng(2).uniform(
+                               1.0, 10.0, (2, T))),))
+    store = run_sweep(nlp, spec, store_dir=tmp_path / "fence",
+                      options=_opts(chunk_size=2))
+    assert store.is_complete
+
+    assert "fence" in events, "backend result was never fenced"
+    first_timer = events.index("timer")
+    last_timer = len(events) - 1 - events[::-1].index("timer")
+    first_fence = events.index("fence")
+    assert first_timer < first_fence < last_timer, (
+        f"fence not inside the timed span: {events}")
+
+
 def test_run_sweep_unknown_name_raises(nlp, tmp_path):
     spec = SweepSpec((grid("not_a_param", np.ones(3)),))
     with pytest.raises(KeyError, match="not_a_param"):
