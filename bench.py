@@ -223,6 +223,61 @@ def _serial_highs_baseline(lmps, cfs, n_serial):
 
 
 # ---------------------------------------------------------------------
+# output contract + perf ledger
+# ---------------------------------------------------------------------
+
+#: the single-line JSON contract downstream consumers (the perf ledger,
+#: tests/test_bench_contract.py) pin
+REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline", "backend")
+ROOFLINE_KEYS = ("device", "peak_flops", "hbm_gbps", "flops_per_solve",
+                 "achieved_gflops", "mfu", "ai_flop_per_byte",
+                 "ai_machine_balance", "bound")
+
+
+def validate_bench_output(out):
+    """Raise ValueError when ``out`` breaks the single-line contract;
+    returns ``out`` unchanged otherwise."""
+    missing = [k for k in REQUIRED_KEYS if k not in out]
+    if missing:
+        raise ValueError(f"bench output missing keys: {missing}")
+    roof = out.get("roofline")
+    if roof is not None:
+        missing = [k for k in ROOFLINE_KEYS if k not in roof]
+        if missing:
+            raise ValueError(f"bench roofline missing sub-keys: {missing}")
+    return out
+
+
+def _finalize_output(out):
+    """Pre-print hook on every exit path: schema check (stderr warning,
+    never fatal) and the perf-ledger append — a no-op unless
+    DISPATCHES_TPU_OBS_LEDGER_DIR is set, and never allowed to kill the
+    headline line."""
+    try:
+        validate_bench_output(out)
+    except ValueError as exc:
+        print(f"bench schema warning: {exc}", file=sys.stderr)
+    try:
+        from dispatches_tpu.obs import ledger
+
+        if not ledger.enabled():
+            return
+        metrics = {"solves_per_sec": out["value"]}
+        if out.get("vs_baseline") is not None:
+            metrics["vs_baseline"] = out["vs_baseline"]
+        serve = out.get("serve") or {}
+        if serve.get("compile_count") is not None:
+            metrics["compile_count"] = serve["compile_count"]
+        ledger.append(ledger.make_record(
+            "bench", out.get("metric", "bench"), metrics,
+            backend=out.get("backend"),
+            extra={"solver_path": out.get("solver_path"),
+                   "mfu": out.get("mfu")}))
+    except Exception as exc:
+        print(f"bench ledger warning: {exc}", file=sys.stderr)
+
+
+# ---------------------------------------------------------------------
 # child: the actual measurement
 # ---------------------------------------------------------------------
 
@@ -496,6 +551,7 @@ def run_bench():
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------
     if backend == "cpu":
+        _finalize_output(out)
         print(json.dumps(out))
         return
 
@@ -613,6 +669,7 @@ def run_bench():
     except Exception as exc:
         out["horizon8736_error"] = str(exc)[:120]
 
+    _finalize_output(out)
     print(json.dumps(out))
 
 

@@ -45,6 +45,15 @@ REGISTERED_FLAGS = {
     "(obs.trace; disabled-by-default fast path otherwise)",
     "OBS_BUFFER": "obs tracer ring-buffer capacity in events "
     "(obs.trace; default 65536, oldest events dropped)",
+    "OBS_PROFILE": "enable AOT cost/memory accounting: per-compile "
+    "cost cards and span-boundary memory gauges (obs.profile; read at "
+    "graft_jit wrap time)",
+    "OBS_LEDGER_DIR": "perf-ledger directory; setting it also enables "
+    "the automatic ledger writes from bench.py and the sweep engine "
+    "(obs.ledger; unset = no writes)",
+    "OBS_LEDGER_TOL": "perf-ledger regression tolerance as a fraction "
+    "of the trailing-window median (obs.ledger --check-regressions; "
+    "default 0.3)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

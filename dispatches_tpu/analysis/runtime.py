@@ -105,6 +105,13 @@ def graft_jit(fun: Callable, *, label: Optional[str] = None, **jit_kwargs):
     The returned callable is a normal jitted function (``lower``,
     ``clear_cache`` etc. all work) with a ``_graft_counter`` attribute
     for introspection.
+
+    When ``DISPATCHES_TPU_OBS_PROFILE`` is set (checked here, at WRAP
+    time — flip it before building solvers, like SANITIZE's trace-time
+    rule), the jitted function is additionally wrapped so each compile
+    records an AOT cost card (``obs.profile``); with the flag off the
+    plain jitted function is returned and call paths carry zero extra
+    host work.
     """
     name = label or getattr(fun, "__name__", None) or repr(fun)
     counter = _CompileCounter(name)
@@ -127,6 +134,13 @@ def graft_jit(fun: Callable, *, label: Optional[str] = None, **jit_kwargs):
 
     jitted = jax.jit(_counted, **jit_kwargs)
     jitted._graft_counter = counter
+    try:  # lazy, like _emit_compile_event: keeps the import discipline
+        from dispatches_tpu.obs import profile
+
+        if profile.enabled():
+            return profile.profiled(jitted, counter)
+    except Exception:
+        pass
     return jitted
 
 

@@ -141,12 +141,15 @@ CORPUS: Dict[str, Dict[str, str]] = {
             if "DISPATCHES_TPU_LUDICROUS" in os.environ:
                 speed = os.environ["DISPATCHES_TPU_LUDICROUS"]
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_TURBO_CHUNK")
+            led = os.environ.get("DISPATCHES_TPU_OBS_LEDGER")
         """,
         "good": """
             import os
 
             slow = os.environ.get("DISPATCHES_TPU_SLOW")
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_CHUNK")
+            prof = os.environ.get("DISPATCHES_TPU_OBS_PROFILE")
+            led_dir = os.environ.get("DISPATCHES_TPU_OBS_LEDGER_DIR")
         """,
     },
 }

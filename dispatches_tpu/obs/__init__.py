@@ -7,7 +7,11 @@ Three pieces, one import surface:
 * :mod:`~dispatches_tpu.obs.trace` — contextvar span tracer with
   explicit device fencing and Chrome-trace export (Perfetto);
 * :mod:`~dispatches_tpu.obs.solverlog` — decode per-iteration IPM /
-  PDLP / Newton convergence telemetry captured inside the jitted solve.
+  PDLP / Newton convergence telemetry captured inside the jitted solve;
+* :mod:`~dispatches_tpu.obs.profile` — opt-in AOT cost/memory cost
+  cards per ``graft_jit`` compile (``DISPATCHES_TPU_OBS_PROFILE``);
+* :mod:`~dispatches_tpu.obs.ledger` — append-only JSONL perf ledger
+  with the ``--check-regressions`` CI gate.
 
 Everything here is disabled by default; set ``DISPATCHES_TPU_OBS=1``
 (or call :func:`enable`) to record, and run
@@ -32,6 +36,7 @@ from dispatches_tpu.obs.solverlog import (  # noqa: F401
     decode_pdlp,
 )
 from dispatches_tpu.obs.trace import (  # noqa: F401
+    dropped,
     enable,
     enabled,
     events,
@@ -45,3 +50,4 @@ from dispatches_tpu.obs.report import (  # noqa: F401
     format_report,
     load_chrome_trace,
 )
+from dispatches_tpu.obs import ledger, profile  # noqa: F401

@@ -12,6 +12,11 @@
     # aggregate a previously exported trace file instead of running
     python -m dispatches_tpu.obs --report --trace-file /tmp/trace.json
 
+    # perf ledger: render the trend, or gate on regressions (exits
+    # non-zero when the latest record regressed beyond tolerance)
+    python -m dispatches_tpu.obs --ledger [--json] [--ledger-dir DIR]
+    python -m dispatches_tpu.obs --check-regressions [--ledger-dir DIR]
+
 The demo workload is a small batch-serve session (the same battery
 arbitrage LP the serve CLI uses) with obs force-enabled, so the report
 exercises the real instrumentation: serve batch spans, ``graft_jit``
@@ -64,7 +69,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--trace-file", metavar="PATH",
                         help="aggregate an exported trace file instead of "
                              "running the demo workload")
+    parser.add_argument("--ledger", action="store_true",
+                        help="render the perf-ledger trend")
+    parser.add_argument("--check-regressions", action="store_true",
+                        help="gate the latest ledger record against the "
+                             "trailing-window median; exit 1 on regression "
+                             "(soft-pass while a group has <3 records)")
+    parser.add_argument("--ledger-dir", metavar="DIR", default=None,
+                        help="ledger directory (default: the "
+                             "DISPATCHES_TPU_OBS_LEDGER_DIR flag, then "
+                             "./perf_ledger)")
+    parser.add_argument("--window", type=int, default=None, metavar="N",
+                        help="trailing-window length for the gate")
+    parser.add_argument("--tol", type=float, default=None,
+                        help="regression tolerance fraction (default: the "
+                             "DISPATCHES_TPU_OBS_LEDGER_TOL flag, then 0.3)")
     args = parser.parse_args(argv)
+
+    if args.ledger or args.check_regressions:
+        return _ledger_main(args)
 
     if not (args.report or args.export_trace):
         parser.print_help()
@@ -82,6 +105,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.export_trace:
         n = trace.export_chrome_trace(args.export_trace, events)
         print(f"wrote {n} event(s) to {args.export_trace}", file=sys.stderr)
+        if trace.dropped():
+            print(f"WARNING: {trace.dropped()} event(s) were evicted from "
+                  "the ring buffer — the exported trace is truncated "
+                  "(raise DISPATCHES_TPU_OBS_BUFFER)", file=sys.stderr)
 
     if args.report:
         if args.json:
@@ -96,6 +123,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(report.format_report(events, snapshot,
                                        dropped=trace.dropped()), end="")
     return 0
+
+
+def _ledger_main(args) -> int:
+    from dispatches_tpu.obs import ledger
+
+    records = ledger.load(args.ledger_dir)
+    rc = 0
+    if args.ledger:
+        if args.json:
+            print(json.dumps({"records": records},
+                             indent=2, sort_keys=True))
+        else:
+            print(ledger.format_trend(records), end="")
+    if args.check_regressions:
+        kw = {}
+        if args.window is not None:
+            kw["window"] = args.window
+        result = ledger.check_regressions(records, tol=args.tol, **kw)
+        if args.json and not args.ledger:
+            print(json.dumps(result, indent=2, sort_keys=True))
+        else:
+            print(ledger.format_check(result), end="")
+        rc = 0 if result["ok"] else 1
+    return rc
 
 
 if __name__ == "__main__":

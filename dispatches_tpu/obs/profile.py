@@ -1,0 +1,240 @@
+"""AOT cost/memory accounting: per-compile **cost cards** and
+span-boundary memory gauges.
+
+The tracer (PR 4) shows *when* a ``graft_jit`` kernel compiled; this
+module shows *what* was compiled: XLA's own flop and bytes-accessed
+estimates plus the peak argument/output/temp memory of the executable,
+taken from the AOT artifact (``jitted.lower(args).compile()`` →
+``cost_analysis()`` / ``memory_analysis()``).  That is the per-program
+ground truth behind the roofline numbers ``bench.py`` estimates
+analytically — and it explains a regression the throughput counters can
+only detect.
+
+Everything is opt-in behind ``DISPATCHES_TPU_OBS_PROFILE`` (or
+:func:`enable`), resolved at ``graft_jit`` **wrap time** like the
+SANITIZE flag is resolved at trace time: with the flag off, ``graft_jit``
+returns the plain jitted callable and the serve/sweep hot paths carry
+zero new host work (pinned by ``tests/test_obs.py``).  With it on, each
+compile (= trace of the counted wrapper) additionally runs one AOT
+lowering of the same arguments — a jit *trace-cache hit*, so the
+compile counter is not disturbed — and records a card into a bounded
+deque, the metrics registry (``profile.*`` gauges), and the trace
+buffer (``compile.cost`` instants riding next to PR 4's ``compile``
+instants).
+
+Memory gauges: while profiling is enabled a sampler runs at every span
+exit — ``profile.live_buffer_bytes`` (summed over ``jax.live_arrays()``,
+works on every backend) and ``profile.device_memory_bytes``
+(``device.memory_stats()['bytes_in_use']``, absent on CPU).
+
+Cost accounting must never break a solve: every recording path is
+wrapped, and a failure simply yields no card.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from dispatches_tpu.analysis.flags import flag_enabled
+from dispatches_tpu.obs import registry, trace
+
+__all__ = [
+    "enabled",
+    "enable",
+    "profiled",
+    "record_compile",
+    "cost_cards",
+    "cards_for",
+    "sample_memory",
+    "reset",
+]
+
+#: bounded card history — a long-running service compiles a handful of
+#: programs per bucket, so 1024 covers any realistic process lifetime
+MAX_CARDS = 1024
+
+_lock = threading.Lock()
+_ENABLED: Optional[bool] = None     # lazily resolved from the env flag
+_CARDS: "deque[Dict]" = deque(maxlen=MAX_CARDS)
+_tls = threading.local()
+
+
+def _install_sampler(on: bool) -> None:
+    trace.set_memory_sampler(sample_memory if on else None)
+
+
+def enabled() -> bool:
+    """Whether cost cards are recorded (``DISPATCHES_TPU_OBS_PROFILE``).
+
+    Read once, lazily; :func:`enable` overrides it for the rest of the
+    process.  ``graft_jit`` consults this at **wrap time** — flipping
+    the flag later does not retrofit accounting onto kernels already
+    wrapped (rebuild them), mirroring the SANITIZE trace-time rule."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = flag_enabled("OBS_PROFILE")
+        if _ENABLED:
+            _install_sampler(True)
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+    _install_sampler(_ENABLED)
+
+
+class _ProfiledJit:
+    """Jitted callable + cost accounting.  Transparent: ``lower``,
+    ``clear_cache`` etc. pass through, and ``_graft_counter`` stays
+    visible (the serve layer's per-bucket compile counts read it)."""
+
+    __slots__ = ("_jitted", "_graft_counter")
+
+    def __init__(self, jitted, counter):
+        self._jitted = jitted
+        self._graft_counter = counter
+
+    def __call__(self, *args, **kwargs):
+        c = self._graft_counter
+        before = c.count
+        out = self._jitted(*args, **kwargs)
+        if c.count > before and enabled():
+            record_compile(self._jitted, c.label, c.count, args, kwargs)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def profiled(jitted, counter) -> _ProfiledJit:
+    """Wrap a ``graft_jit``-produced jitted callable so each compile
+    records a cost card (``graft_jit`` calls this when :func:`enabled`
+    resolves True at wrap time)."""
+    return _ProfiledJit(jitted, counter)
+
+
+def _describe_arg(a) -> str:
+    """Short shape summary for one call argument (card metadata)."""
+    import jax
+    import numpy as np
+
+    try:
+        leaves = jax.tree_util.tree_leaves(a)
+        if len(leaves) == 1 and hasattr(leaves[0], "shape"):
+            leaf = leaves[0]
+            return f"{getattr(leaf, 'dtype', '?')}{list(np.shape(leaf))}"
+        return f"pytree[{len(leaves)} leaves]"
+    except Exception:
+        return type(a).__name__
+
+
+def record_compile(jitted, label: str, count: int,
+                   args, kwargs) -> Optional[Dict]:
+    """AOT-lower ``jitted`` on the compile's own arguments and record
+    the cost card; returns it (None on any failure — telemetry never
+    breaks a solve).
+
+    The re-lowering hits the jit *trace cache* (the counted wrapper is
+    not re-executed, so compile accounting stays clean); only the XLA
+    compile re-runs, which the persistent compile cache absorbs."""
+    if getattr(_tls, "busy", False):  # re-entrant lower() guard
+        return None
+    _tls.busy = True
+    try:
+        import jax
+
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args, **kwargs).compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # list-of-dicts on some jax
+            cost = cost[0] if cost else {}
+        cost = cost or {}
+        mem = compiled.memory_analysis()
+        card = {
+            "label": label,
+            "count": int(count),
+            "backend": jax.default_backend(),
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "generated_code_bytes": int(
+                getattr(mem, "generated_code_size_in_bytes", 0)),
+            "compile_ms": round(compile_ms, 3),
+            "shapes": [_describe_arg(a) for a in args[:8]],
+        }
+        card["peak_bytes"] = (card["argument_bytes"] + card["output_bytes"]
+                              + card["temp_bytes"])
+        with _lock:
+            _CARDS.append(card)
+        trace.instant("compile.cost", **card)
+        registry.gauge(
+            "profile.flops", "XLA flop estimate of the latest compile"
+        ).set(card["flops"], label=label)
+        registry.gauge(
+            "profile.bytes_accessed", "XLA bytes-accessed estimate"
+        ).set(card["bytes_accessed"], label=label)
+        registry.gauge(
+            "profile.peak_bytes", "argument+output+temp bytes of the "
+            "compiled executable"
+        ).set(card["peak_bytes"], label=label)
+        registry.counter(
+            "profile.cost_cards", "cost cards recorded"
+        ).inc(label=label)
+        registry.histogram(
+            "profile.compile_ms", "AOT compile wall time"
+        ).observe(card["compile_ms"])
+        return card
+    except Exception:
+        return None
+    finally:
+        _tls.busy = False
+
+
+def cost_cards() -> List[Dict]:
+    """Snapshot of every recorded card, oldest first."""
+    with _lock:
+        return list(_CARDS)
+
+
+def cards_for(prefix: str) -> List[Dict]:
+    """Cards whose label starts with ``prefix`` (e.g. ``serve.pdlp#0``
+    for one bucket, ``sweep.`` for every sweep kernel)."""
+    return [c for c in cost_cards() if c["label"].startswith(prefix)]
+
+
+def sample_memory() -> Dict[str, int]:
+    """Update the memory gauges and return them; installed as the
+    tracer's span-boundary sampler while profiling is enabled."""
+    import jax
+
+    out: Dict[str, int] = {}
+    live = 0
+    for a in jax.live_arrays():
+        live += int(getattr(a, "nbytes", 0) or 0)
+    out["live_buffer_bytes"] = live
+    registry.gauge(
+        "profile.live_buffer_bytes", "summed nbytes of live jax arrays"
+    ).set(live)
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats and "bytes_in_use" in stats:  # None on CPU
+        out["device_memory_bytes"] = int(stats["bytes_in_use"])
+        registry.gauge(
+            "profile.device_memory_bytes", "device allocator bytes in use"
+        ).set(out["device_memory_bytes"])
+    return out
+
+
+def reset() -> None:
+    """Drop every recorded card (tests)."""
+    with _lock:
+        _CARDS.clear()

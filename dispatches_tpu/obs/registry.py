@@ -74,8 +74,8 @@ class _Instrument:
         with self._lock:
             return list(self._series_keys())
 
-    def _series_keys(self) -> Iterable[LabelKey]:  # pragma: no cover
-        raise NotImplementedError
+    def _series_keys(self) -> Iterable[LabelKey]:
+        return ()  # subclasses expose their label sets
 
 
 class _BoundCounter:
@@ -196,6 +196,26 @@ class _Window:
         return out
 
 
+class _BoundWindow:
+    """Hot-path handle for one pre-resolved histogram series — the
+    :class:`_BoundCounter` pattern for observations (the serve layer's
+    per-request queue-wait recording uses it)."""
+
+    __slots__ = ("_hist", "_key")
+
+    def __init__(self, hist: "Histogram", key: LabelKey):
+        self._hist = hist
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        h = self._hist
+        with h._lock:
+            w = h._windows.get(self._key)
+            if w is None:
+                w = h._windows[self._key] = _Window(h.window_size)
+            w.observe(float(value))
+
+
 class Histogram(_Instrument):
     """Sliding-window value distribution with cheap exact quantiles."""
 
@@ -216,6 +236,9 @@ class Histogram(_Instrument):
     def observe(self, value: float, **labels) -> None:
         with self._lock:
             self._window(labels).observe(value)
+
+    def labeled(self, **labels) -> _BoundWindow:
+        return _BoundWindow(self, _label_key(labels))
 
     def count(self, **labels) -> int:
         with self._lock:

@@ -56,6 +56,12 @@ def format_report(events: List[Dict],
     lines = ["== dispatches_tpu.obs report =="]
     lines.append(f"events: {len(events)} buffered"
                  + (f", {dropped} dropped" if dropped else ""))
+    if dropped:
+        lines.append(
+            f"WARNING: {dropped} event(s) were evicted from the ring "
+            "buffer — this report and any exported trace are truncated "
+            "(raise DISPATCHES_TPU_OBS_BUFFER)"
+        )
     if spans:
         lines.append("spans:")
         width = max(len(n) for n in spans)

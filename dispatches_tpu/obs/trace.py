@@ -39,7 +39,9 @@ __all__ = [
     "span",
     "instant",
     "events",
+    "dropped",
     "reset",
+    "set_memory_sampler",
     "export_chrome_trace",
     "to_chrome_events",
 ]
@@ -56,6 +58,18 @@ _DROPPED = 0
 _STACK: contextvars.ContextVar = contextvars.ContextVar(
     "dispatches_tpu_obs_span_stack", default=()
 )
+
+# span-boundary hook (obs.profile installs its memory sampler here);
+# module-global so the Span hot path pays one attribute read when unset
+_SPAN_HOOK = None
+
+
+def set_memory_sampler(fn) -> None:
+    """Install ``fn`` to run at every span exit (None uninstalls).
+    Exceptions from the sampler are swallowed — telemetry never breaks
+    the traced operation."""
+    global _SPAN_HOOK
+    _SPAN_HOOK = fn
 
 
 def enabled() -> bool:
@@ -142,6 +156,12 @@ class Span:
             "tid": threading.get_ident(),
             "args": args,
         })
+        hook = _SPAN_HOOK
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass
         return False
 
 
@@ -227,7 +247,13 @@ def export_chrome_trace(path, evts: Optional[List[Dict]] = None) -> int:
     """Write the buffered events as Chrome trace-event JSON (Perfetto /
     ``chrome://tracing`` compatible); returns the event count."""
     chrome = to_chrome_events(evts)
-    payload = {"traceEvents": chrome, "displayTimeUnit": "ms"}
+    payload = {
+        "traceEvents": chrome,
+        "displayTimeUnit": "ms",
+        # drops are part of the artifact: a truncated Perfetto view
+        # should say so instead of silently looking complete
+        "otherData": {"events_dropped": dropped()},
+    }
     with open(path, "w") as f:
         json.dump(payload, f)
     return len(chrome)

@@ -57,6 +57,41 @@ class LatencyWindow(Histogram):
         return out
 
 
+class QueueWaitWindow(Histogram):
+    """Sliding window of queue waits (submit→dispatch, ms): one labeled
+    series per bucket plus the unlabeled aggregate, so backpressure is
+    attributable to a bucket and still summarizable service-wide.
+    Distinct from :class:`LatencyWindow` (submit→result): the gap
+    between the two is solve time."""
+
+    def __init__(self, maxlen: int = 4096):
+        super().__init__("serve.queue_wait_ms",
+                         "request queue wait (submit -> dispatch)",
+                         window=maxlen)
+        with self._lock:
+            self._w0 = self._window({})
+        # bound per-bucket cells, resolved once (hot path: per request)
+        self._cells: Dict[str, object] = {}
+
+    def record(self, bucket_label: str, wait_ms: float) -> None:
+        cell = self._cells.get(bucket_label)
+        if cell is None:
+            cell = self._cells[bucket_label] = self.labeled(
+                bucket=bucket_label)
+        with self._lock:
+            self._w0.observe(float(wait_ms))
+        cell.observe(wait_ms)
+
+    def summary_ms(self, **labels) -> Dict[str, float]:
+        s = Histogram.summary(self, **labels)
+        out = {"count": s["count"]}
+        if "mean" in s:
+            out["mean_ms"] = s["mean"]
+            out["p50_ms"] = s["p50"]
+            out["p99_ms"] = s["p99"]
+        return out
+
+
 class BucketStats:
     """Counters for one shape bucket (Counter-backed, label ``event=``)."""
 
@@ -157,6 +192,12 @@ def format_stats(metrics: Dict) -> str:
             "latency: mean {mean_ms} ms, p50 {p50_ms} ms, p99 {p99_ms} ms "
             "over {count} request(s)".format(**lat)
         )
+    qw = metrics.get("queue_wait") or {}
+    if qw.get("count"):
+        lines.append(
+            "queue wait: mean {mean_ms} ms, p50 {p50_ms} ms, "
+            "p99 {p99_ms} ms over {count} request(s)".format(**qw)
+        )
     ws = metrics["warm_start"]
     lines.append(
         "warm starts: {hits} hit(s) / {misses} miss(es), "
@@ -171,5 +212,15 @@ def format_stats(metrics: Dict) -> str:
                 f"  {label}: {b['submitted']} req, {b['batches']} batch(es) "
                 f"@ lanes {b['lane_counts']}, occupancy {occ}, "
                 f"{b['timeouts']} timeout(s), {b['compiles']} compile(s)"
+            )
+    cards = metrics.get("cost_cards") or {}
+    if cards:  # only with DISPATCHES_TPU_OBS_PROFILE (golden unchanged)
+        lines.append("cost cards (latest compile per bucket):")
+        for label, c in sorted(cards.items()):
+            lines.append(
+                f"  {label}: {c['flops']:.3e} flops, "
+                f"{c['bytes_accessed']:.3e} bytes accessed, "
+                f"peak {c['peak_bytes'] / 1e6:.3f} MB, "
+                f"compile {c['compile_ms']:.0f} ms @ {c['backend']}"
             )
     return "\n".join(lines)

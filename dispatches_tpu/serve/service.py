@@ -57,7 +57,12 @@ from dispatches_tpu.serve.bucket import (
     params_signature,
     request_fingerprint,
 )
-from dispatches_tpu.serve.metrics import BucketStats, LatencyWindow, format_stats
+from dispatches_tpu.serve.metrics import (
+    BucketStats,
+    LatencyWindow,
+    QueueWaitWindow,
+    format_stats,
+)
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
 
@@ -261,6 +266,7 @@ class SolveService:
         self._clock = clock
         self._buckets: Dict = {}
         self._latency = LatencyWindow(self.options.latency_window)
+        self._queue_wait = QueueWaitWindow(self.options.latency_window)
         self._warm = _WarmStartCache(self.options.warm_cache_size)
         self._warm_hits = 0
         self._warm_misses = 0
@@ -425,6 +431,9 @@ class SolveService:
                 live.append(r)
         if not live:
             return n
+        for r in live:  # queue wait = submit -> this dispatch instant
+            self._queue_wait.record(bucket.stats.label,
+                                    (now - r.submitted_at) * 1e3)
         lanes = pad_lanes(len(live), self.options.max_batch)
         pad = lanes - len(live)
         plist = [r.params for r in live] + [live[-1].params] * pad
@@ -475,6 +484,17 @@ class SolveService:
         """Plain-dict service telemetry (see docs/serve.md)."""
         buckets = {b.stats.label: b.stats.as_dict(b.compiles)
                    for b in self._buckets.values()}
+        cost_cards: Dict = {}
+        try:  # per-bucket AOT cost cards, present only when profiling
+            from dispatches_tpu.obs import profile
+
+            if profile.enabled():
+                for b in self._buckets.values():
+                    cards = profile.cards_for(f"serve.{b.stats.label}")
+                    if cards:
+                        cost_cards[b.stats.label] = cards[-1]
+        except Exception:
+            pass
         live = sum(b.stats.live_dispatched for b in self._buckets.values())
         lanes = sum(b.stats.lanes_dispatched for b in self._buckets.values())
         return {
@@ -491,10 +511,12 @@ class SolveService:
             "programs": sum(len(b.stats.lane_counts)
                             for b in self._buckets.values()),
             "latency": self._latency.summary(),
+            "queue_wait": self._queue_wait.summary_ms(),
             "warm_start": {"hits": self._warm_hits,
                            "misses": self._warm_misses,
                            "size": len(self._warm)},
             "buckets": buckets,
+            "cost_cards": cost_cards,
         }
 
     def format_stats(self) -> str:

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import pad_lanes, request_fingerprint
 from dispatches_tpu.sweep.spec import SweepSpec
@@ -216,11 +217,75 @@ def run_sweep(nlp, spec: SweepSpec, *,
             "status": status,
             "retries": retries,
             "inputs": spec.inputs_for(idxs),
-        }, time.perf_counter() - t0)
+        }, time.perf_counter() - t0,
+            extra=_chunk_cost_telemetry(opts, n_live))
         ran += 1
         if on_chunk is not None:
             on_chunk(cid, len(plan))
+    _ledger_record(store, opts, solve_chunk)
     return store
+
+
+def _chunk_cost_telemetry(opts: "SweepOptions",
+                          n_live: int) -> Optional[Dict]:
+    """Per-chunk bytes/point from the latest AOT cost card (only under
+    DISPATCHES_TPU_OBS_PROFILE; the mesh backend has no graft_jit
+    kernel of its own and reports nothing).  Approximate by design: the
+    card describes the compiled program of this chunk's lane width,
+    bytes are split evenly across padded lanes."""
+    try:
+        from dispatches_tpu.obs import profile
+
+        if not profile.enabled():
+            return None
+        prefix = {"direct": "sweep.", "serve": "serve."}.get(
+            opts.backend.lower())
+        cards = profile.cards_for(prefix) if prefix else []
+        if not cards:
+            return None
+        width = pad_lanes(n_live, opts.chunk_size)
+        return {"bytes_per_point":
+                round(cards[-1]["bytes_accessed"] / max(width, 1), 1)}
+    except Exception:
+        return None
+
+
+def _ledger_record(store: ResultStore, opts: "SweepOptions",
+                   solve_chunk) -> None:
+    """Append this run's throughput/compile/memory record to the perf
+    ledger — only when DISPATCHES_TPU_OBS_LEDGER_DIR is set (tier-1
+    stays write-free), and never at the expense of the sweep itself."""
+    try:
+        from dispatches_tpu.obs import ledger
+
+        if not ledger.enabled():
+            return
+        s = store.summary()
+        metrics: Dict = {}
+        if s.get("solves_per_sec") is not None:
+            metrics["solves_per_sec"] = s["solves_per_sec"]
+        counter = getattr(solve_chunk, "_graft_counter", None)
+        if counter is not None:
+            metrics["compile_count"] = int(counter.count)
+        try:
+            from dispatches_tpu.obs import profile
+
+            cards = profile.cards_for("sweep.")
+            if cards:
+                metrics["peak_bytes"] = max(c["peak_bytes"] for c in cards)
+        except Exception:
+            pass
+        if not metrics:
+            return
+        import jax
+
+        ledger.append(ledger.make_record(
+            "sweep", store.fingerprint[:12], metrics,
+            backend=jax.default_backend(),
+            extra={"dispatch": opts.backend,
+                   "chunks_done": s.get("chunks_done")}))
+    except Exception:
+        pass
 
 
 def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
@@ -235,7 +300,11 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             "fixed": {k: (0 if k in names_f else None)
                       for k in defaults["fixed"]},
         }
-        vrun = jax.jit(jax.vmap(base, in_axes=(in_axes,)))
+        # graft_jit (not bare jax.jit): chunk widths are shape-stable,
+        # so compile accounting — and, under OBS_PROFILE, per-program
+        # cost cards feeding the report's bytes/point — applies here too
+        vrun = graft_jit(jax.vmap(base, in_axes=(in_axes,)),
+                         label="sweep.direct")
 
         def solve_chunk(values, n_live):
             width = pad_lanes(n_live, opts.chunk_size)
@@ -252,6 +321,7 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             return _extract(
                 jax.block_until_ready(vrun({"p": p, "fixed": f})), n_live)
 
+        solve_chunk._graft_counter = vrun._graft_counter
         return solve_chunk
 
     if backend == "mesh":

@@ -167,12 +167,15 @@ class ResultStore:
     # -- recording ---------------------------------------------------------
 
     def record_chunk(self, cid: int, arrays: Dict[str, np.ndarray],
-                     wall_s: float) -> None:
+                     wall_s: float,
+                     extra: Optional[Dict] = None) -> None:
         """Durably record one solved chunk: chunk npz first (atomic),
         then the manifest status flip (atomic), then progress telemetry.
         A kill between the steps leaves at worst a solved chunk the
         manifest still calls pending — resume re-solves it to the
-        identical bytes."""
+        identical bytes.  ``extra`` (e.g. the engine's bytes/point cost
+        telemetry) merges into the progress entry only — progress.json
+        is run telemetry, never store identity."""
         entry = self._manifest["chunks"][str(cid)]
         save_state(self.path / entry["file"], arrays)
         entry["status"] = "done"
@@ -180,10 +183,13 @@ class ResultStore:
         prog_path = self.path / _PROGRESS
         prog = (json.loads(prog_path.read_text())
                 if prog_path.is_file() else {"chunks": {}})
-        prog["chunks"][str(cid)] = {
+        chunk_entry = {
             "wall_s": round(float(wall_s), 6),
             "n": int(len(arrays["obj"])),
         }
+        if extra:
+            chunk_entry.update(extra)
+        prog["chunks"][str(cid)] = chunk_entry
         _atomic_json(prog_path, prog)
 
     # -- reading -----------------------------------------------------------
@@ -268,6 +274,10 @@ class ResultStore:
                 out["solves_per_sec_steady"] = (
                     round(float(np.sum(ns[1:])) / steady, 2)
                     if steady > 0 else None)
+        bpp = [c["bytes_per_point"] for c in chunks_t
+               if "bytes_per_point" in c]
+        if bpp:  # engine cost telemetry (DISPATCHES_TPU_OBS_PROFILE)
+            out["bytes_per_point"] = round(float(np.mean(bpp)), 1)
         return out
 
 
@@ -288,6 +298,8 @@ def format_report(summary: Dict) -> str:
     if "wall_s" in summary:
         tail = (f" · {summary['solves_per_sec_steady']} steady"
                 if "solves_per_sec_steady" in summary else "")
+        if "bytes_per_point" in summary:
+            tail += f" · {summary['bytes_per_point']:.0f} bytes/point"
         lines.append(
             f"  throughput: {summary['solves_per_sec']} solves/s"
             f"{tail} · wall {summary['wall_s']} s")

@@ -1,0 +1,88 @@
+"""bench.py output contract: the single JSON line every bench child
+prints is schema-pinned here (keys ``metric``/``value``/``unit``/
+``vs_baseline``/``backend`` plus the roofline sub-keys), and a bench
+record round-trips bitwise through the perf ledger.
+
+bench.py is a script, not a package module — load it by path.  Its
+module top imports only stdlib + numpy (jax is deferred into
+``run_bench``), so the import is tier-1 cheap.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from dispatches_tpu.obs import ledger
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r05_cpu_preview.json")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_preview_record_passes_schema(bench):
+    out = json.load(open(PREVIEW))
+    bench.validate_bench_output(out)  # raises on a contract break
+    for key in bench.REQUIRED_KEYS:
+        assert key in out
+    for key in bench.ROOFLINE_KEYS:
+        assert key in out["roofline"]
+
+
+def test_validate_rejects_missing_keys(bench):
+    out = json.load(open(PREVIEW))
+    del out["vs_baseline"]
+    with pytest.raises(ValueError, match="vs_baseline"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["roofline"]["mfu"]
+    with pytest.raises(ValueError, match="mfu"):
+        bench.validate_bench_output(out)
+    # roofline itself is optional (CPU preview path may omit it)
+    out = json.load(open(PREVIEW))
+    del out["roofline"]
+    bench.validate_bench_output(out)
+
+
+def test_bench_record_round_trips_through_ledger(bench, tmp_path):
+    """A bench-shaped ledger record survives append/load bitwise."""
+    out = json.load(open(PREVIEW))
+    rec = ledger.make_record(
+        "bench", out["metric"],
+        {"solves_per_sec": out["value"], "vs_baseline": out["vs_baseline"]},
+        backend=out["backend"],
+        extra={"solver_path": out["solver_path"], "mfu": out["mfu"]},
+    )
+    ledger.append(rec, tmp_path)
+    loaded = ledger.load(tmp_path)
+    assert len(loaded) == 1
+    assert (json.dumps(loaded[0], sort_keys=True)
+            == json.dumps(rec, sort_keys=True))
+
+
+def test_finalize_is_nonfatal_and_gated(bench, tmp_path, monkeypatch, capsys):
+    """_finalize_output never raises on a bad record, and only writes
+    the ledger when DISPATCHES_TPU_OBS_LEDGER_DIR is set."""
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_LEDGER_DIR", raising=False)
+    out = json.load(open(PREVIEW))
+    bench._finalize_output(out)
+    assert not (tmp_path / ledger.LEDGER_FILE).exists()
+
+    bench._finalize_output({"metric": "broken"})  # invalid: warns, no raise
+    assert "bench schema warning" in capsys.readouterr().err
+
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_LEDGER_DIR", str(tmp_path))
+    bench._finalize_output(out)
+    recs = ledger.load(tmp_path)
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "bench"
+    assert recs[0]["metrics"]["solves_per_sec"] == out["value"]

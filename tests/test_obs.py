@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 from dispatches_tpu import Flowsheet
+from dispatches_tpu.obs import ledger, profile
 from dispatches_tpu.obs import registry as reg
 from dispatches_tpu.obs import report, solverlog, trace
 
@@ -21,12 +22,16 @@ GOLDEN = os.path.join(os.path.dirname(__file__), "data",
 
 @pytest.fixture(autouse=True)
 def _clean_tracer():
-    """Every test starts with tracing off and an empty buffer."""
+    """Every test starts with tracing and profiling off, empty buffers."""
     trace.enable(False)
     trace.reset()
+    profile.enable(False)
+    profile.reset()
     yield
     trace.enable(False)
     trace.reset()
+    profile.enable(False)
+    profile.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +168,26 @@ def test_report_aggregates_spans_and_instants():
     assert "solve" in text and "compile" in text
 
 
+def test_report_and_export_surface_dropped_events(monkeypatch, tmp_path):
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_BUFFER", "4")
+    trace.reset()  # re-resolve the buffer size from the env
+    trace.enable(True)
+    for i in range(10):
+        trace.instant("tick", i=i)
+    text = report.format_report(trace.events(), dropped=trace.dropped())
+    assert "WARNING: 6 event(s) were evicted" in text
+    path = tmp_path / "t.json"
+    trace.export_chrome_trace(path)
+    payload = json.loads(path.read_text())
+    assert payload["otherData"]["events_dropped"] == 6
+    # no drops -> no warning line
+    trace.reset()
+    trace.enable(True)
+    trace.instant("tick")
+    assert "WARNING" not in report.format_report(
+        trace.events(), dropped=trace.dropped())
+
+
 # ---------------------------------------------------------------------------
 # serve --stats golden (registry rebase must be byte-invisible)
 # ---------------------------------------------------------------------------
@@ -292,6 +317,140 @@ def test_graft_jit_emits_compile_instant():
         label="obs.test.add") == before + 1
 
 
+# ---------------------------------------------------------------------------
+# profile: cost cards + memory gauges
+# ---------------------------------------------------------------------------
+
+
+def _small_serve(clock=None, n_requests=6, horizon=6, max_batch=4):
+    """The golden workload (deterministic when given the ticking clock)."""
+    from dispatches_tpu.serve import ServeOptions, SolveService
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+
+    kw = {"clock": clock} if clock is not None else {}
+    service = SolveService(
+        ServeOptions(max_batch=max_batch, max_wait_ms=1e9), **kw)
+    nlp = _arbitrage_nlp(horizon)
+    defaults = nlp.default_params()
+    rng = np.random.default_rng(0)
+    handles = []
+    for _ in range(n_requests):
+        price = 30.0 + 10.0 * rng.standard_normal(horizon)
+        params = {"p": {**defaults["p"], "price": price},
+                  "fixed": defaults["fixed"]}
+        handles.append(service.submit(nlp, params, solver="pdlp"))
+    service.flush_all()
+    return service, handles
+
+
+def test_profile_cost_card_on_compile():
+    from dispatches_tpu.analysis.runtime import graft_jit
+
+    profile.enable(True)
+    trace.enable(True)
+    f = graft_jit(lambda a: (a * 2.0).sum(), label="obs.test.card")
+    assert isinstance(f, profile._ProfiledJit)
+    f(np.arange(8.0))
+    f(np.arange(8.0))  # jit cache hit: no second card
+    cards = profile.cards_for("obs.test.card")
+    assert len(cards) == 1
+    card = cards[0]
+    assert card["flops"] > 0
+    assert card["bytes_accessed"] > 0
+    assert card["peak_bytes"] > 0
+    assert card["backend"] == jax.default_backend()
+    assert card["compile_ms"] >= 0
+    assert card["shapes"] and "[8]" in card["shapes"][0]
+    # the AOT re-lowering hits the jit trace cache: the counted wrapper
+    # is not re-run, so compile accounting stays at one
+    assert f._graft_counter.count == 1
+    insts = [e for e in trace.events() if e["name"] == "compile.cost"
+             and e["args"]["label"] == "obs.test.card"]
+    assert len(insts) == 1 and insts[0]["args"]["flops"] > 0
+    assert reg.gauge("profile.flops").value(
+        label="obs.test.card") == card["flops"]
+
+
+def test_profile_off_returns_plain_jit():
+    from dispatches_tpu.analysis.runtime import graft_jit
+
+    assert not profile.enabled()
+    f = graft_jit(lambda a: a + 1.0, label="obs.test.plain")
+    assert not isinstance(f, profile._ProfiledJit)
+    f(np.float64(1.0))
+    assert profile.cards_for("obs.test.plain") == []
+
+
+def test_profile_off_serve_hot_path_untouched(monkeypatch):
+    """Acceptance: profiling fully off => zero new host work on the
+    serve path — buckets run the plain jitted callable and
+    ``record_compile`` is never reached."""
+    calls = []
+    monkeypatch.setattr(profile, "record_compile",
+                        lambda *a, **k: calls.append(a) or None)
+    service, handles = _small_serve()
+    assert all(h.result().status == "DONE" for h in handles)
+    assert calls == []
+    for b in service._buckets.values():
+        assert not isinstance(b.run, profile._ProfiledJit)
+    assert service.metrics()["cost_cards"] == {}
+
+
+def test_serve_stats_cost_cards_with_profiling():
+    profile.enable(True)
+    service, handles = _small_serve()
+    assert all(h.result().status == "DONE" for h in handles)
+    cards = service.metrics()["cost_cards"]
+    assert set(cards) == {"pdlp#0"}
+    c = cards["pdlp#0"]
+    assert c["flops"] > 0 and c["bytes_accessed"] > 0 and c["peak_bytes"] > 0
+    text = service.format_stats()
+    assert "cost cards (latest compile per bucket):" in text
+    assert "  pdlp#0:" in text.split("cost cards")[1]
+
+
+def test_memory_gauges_sampled_at_span_exit():
+    profile.enable(True)
+    trace.enable(True)
+    keep = jax.numpy.arange(1024.0)  # live across the span boundary
+    with trace.span("obs.test.mem"):
+        pass
+    live = reg.gauge("profile.live_buffer_bytes").value()
+    assert live is not None and live >= keep.nbytes
+    # sampler is uninstalled with profiling
+    profile.enable(False)
+    reg.gauge("profile.live_buffer_bytes").set(-1.0)
+    with trace.span("obs.test.mem2"):
+        pass
+    assert reg.gauge("profile.live_buffer_bytes").value() == -1.0
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# queue-wait histogram
+# ---------------------------------------------------------------------------
+
+
+def test_queue_wait_histogram_per_bucket():
+    ticks = {"t": 0.0}
+
+    def clock():
+        ticks["t"] += 0.25e-3
+        return ticks["t"]
+
+    service, handles = _small_serve(clock=clock)
+    assert all(h.result().status == "DONE" for h in handles)
+    qw = service.metrics()["queue_wait"]
+    assert qw["count"] == 6
+    assert qw["mean_ms"] > 0
+    # per-bucket labeled series carries the same six observations
+    assert service._queue_wait.count(bucket="pdlp#0") == 6
+    # queue wait (submit->dispatch) is bounded by latency (submit->result)
+    lat = service.metrics()["latency"]
+    assert qw["mean_ms"] < lat["mean_ms"]
+    assert "queue wait: mean" in service.format_stats()
+
+
 @pytest.mark.skipif(
     not os.environ.get("DISPATCHES_TPU_SLOW"),
     reason="full 1-day double-loop co-simulation on a synthetic 2-bus "
@@ -390,6 +549,7 @@ def test_acceptance_double_loop_trace_export(tmp_path):
 
     trace.enable(True)
     trace.reset()
+    profile.enable(True)  # PR 5: cost cards ride along in the same trace
     sim = MarketSimulator(
         case, output_dir=tmp_path / "dl_obs", sced_horizon=1,
         ruc_horizon=24, reserve_factor=0.0, coordinator=coord,
@@ -425,6 +585,23 @@ def test_acceptance_double_loop_trace_export(tmp_path):
     assert "serve.batch" in names
     compiles = [e for e in evts if e["name"] == "compile" and e["ph"] == "i"]
     assert len(compiles) >= 1
+    # PR 5 acceptance: compile instants carry cost cards — every
+    # compile.cost instant has real flop/byte/peak numbers (CPU included)
+    cost_insts = [e for e in evts if e["name"] == "compile.cost"]
+    assert len(cost_insts) >= 1
+    for e in cost_insts:
+        assert e["args"]["flops"] > 0
+        assert e["args"]["bytes_accessed"] > 0
+        assert e["args"]["peak_bytes"] > 0
+    assert service.metrics()["cost_cards"], "per-bucket cost cards missing"
+    # and the run lands in a perf ledger that round-trips
+    rec = ledger.make_record(
+        "double_loop", "2bus_1day",
+        {"solves_per_sec": 24.0, "compile_count": len(compiles),
+         "peak_bytes": max(e["args"]["peak_bytes"] for e in cost_insts)},
+        backend=jax.default_backend())
+    ledger.append(rec, tmp_path / "ledger")
+    assert ledger.load(tmp_path / "ledger") == [rec]
     # nested bid/track spans carry the cycle parent
     sced_children = [e for e in evts
                      if e["args"].get("parent") == "market.sced"]
@@ -455,3 +632,93 @@ def test_obs_cli_report_json(tmp_path, capsys):
     text = capsys.readouterr().out
     assert text.startswith("== dispatches_tpu.obs report ==")
     assert "serve.batch" in text
+
+
+# ---------------------------------------------------------------------------
+# perf ledger + regression gate
+# ---------------------------------------------------------------------------
+
+
+def _seed_ledger(d, values, metric="solves_per_sec", **extra_metrics):
+    for v in values:
+        ledger.append(ledger.make_record(
+            "bench", "test_wl", {metric: v, **extra_metrics},
+            backend="cpu"), d)
+
+
+def test_ledger_gate_flat_trend_passes(tmp_path, capsys):
+    """ISSUE 5 acceptance: a synthetic 3-record ledger passes the gate
+    on a flat trend..."""
+    from dispatches_tpu.obs.__main__ import main
+
+    _seed_ledger(tmp_path, [100.0, 101.0, 99.5])
+    rc = main(["--check-regressions", "--ledger-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "verdict: PASS" in out
+    assert "solves_per_sec" in out
+
+
+def test_ledger_gate_fails_on_throughput_drop(tmp_path, capsys):
+    """...and exits non-zero on an injected 2x throughput drop."""
+    from dispatches_tpu.obs.__main__ import main
+
+    _seed_ledger(tmp_path, [100.0, 101.0, 50.0])
+    rc = main(["--check-regressions", "--ledger-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "verdict: REGRESSION" in out
+    result = ledger.check_regressions(ledger.load(tmp_path))
+    assert not result["ok"]
+    assert [e["metric"] for e in result["regressions"]] == ["solves_per_sec"]
+
+
+def test_ledger_gate_lower_is_better_metrics(tmp_path):
+    # memory is gated in the opposite direction: growth is the regression
+    _seed_ledger(tmp_path, [100.0, 100.0, 100.0], peak_bytes=1000)
+    assert ledger.check_regressions(ledger.load(tmp_path))["ok"]
+    ledger.append(ledger.make_record(
+        "bench", "test_wl", {"solves_per_sec": 100.0, "peak_bytes": 5000},
+        backend="cpu"), tmp_path)
+    result = ledger.check_regressions(ledger.load(tmp_path))
+    assert not result["ok"]
+    assert [e["metric"] for e in result["regressions"]] == ["peak_bytes"]
+
+
+def test_ledger_gate_soft_passes_below_min_records(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    _seed_ledger(tmp_path, [100.0, 50.0])  # 2 < MIN_RECORDS, even with a drop
+    rc = main(["--check-regressions", "--ledger-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "skip" in out and "gate needs history" in out
+    assert "verdict: PASS" in out
+
+
+def test_ledger_trend_cli_and_torn_line(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    _seed_ledger(tmp_path, [100.0, 101.0])
+    # a killed writer leaves a torn last line; load() must skip it
+    with open(tmp_path / ledger.LEDGER_FILE, "a") as f:
+        f.write('{"schema": 1, "truncat')
+    assert len(ledger.load(tmp_path)) == 2
+    rc = main(["--ledger", "--ledger-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.startswith("== dispatches_tpu.obs perf ledger ==")
+    assert "bench/test_wl/cpu:" in out
+    rc = main(["--ledger", "--json", "--ledger-dir", str(tmp_path)])
+    payload = json.loads(capsys.readouterr().out)
+    assert len(payload["records"]) == 2
+    assert payload["records"][0]["metrics"]["solves_per_sec"] == 100.0
+
+
+def test_ledger_writes_off_by_default(tmp_path, monkeypatch):
+    # tier-1 discipline: no OBS_LEDGER_DIR -> automatic writes disabled
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_LEDGER_DIR", raising=False)
+    assert not ledger.enabled()
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_LEDGER_DIR", str(tmp_path))
+    assert ledger.enabled()
+    assert ledger.default_dir() == str(tmp_path)
