@@ -232,6 +232,10 @@ REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline", "backend")
 ROOFLINE_KEYS = ("device", "peak_flops", "hbm_gbps", "flops_per_solve",
                  "achieved_gflops", "mfu", "ai_flop_per_byte",
                  "ai_machine_balance", "bound")
+#: per-variant sub-keys of the ``pdlp_variant`` A/B section (one
+#: sub-dict per algorithm in solvers.pdlp.PDLP_ALGORITHMS, same batch)
+PDLP_VARIANT_KEYS = ("pdhg_iters_mean", "solves_per_sec",
+                     "obj_rel_err_vs_highs")
 
 
 def validate_bench_output(out):
@@ -245,6 +249,17 @@ def validate_bench_output(out):
         missing = [k for k in ROOFLINE_KEYS if k not in roof]
         if missing:
             raise ValueError(f"bench roofline missing sub-keys: {missing}")
+    variant = out.get("pdlp_variant")
+    if variant is not None:
+        for algo in ("avg", "halpern"):
+            sub = variant.get(algo)
+            if sub is None:
+                raise ValueError(f"bench pdlp_variant missing '{algo}'")
+            missing = [k for k in PDLP_VARIANT_KEYS if k not in sub]
+            if missing:
+                raise ValueError(
+                    f"bench pdlp_variant[{algo!r}] missing sub-keys: "
+                    f"{missing}")
     return out
 
 
@@ -268,11 +283,16 @@ def _finalize_output(out):
         serve = out.get("serve") or {}
         if serve.get("compile_count") is not None:
             metrics["compile_count"] = serve["compile_count"]
+        # iteration count is a gated metric (lower is better): the
+        # guardrail for the reflected-Halpern solver upgrade
+        if out.get("pdhg_iters_mean") is not None:
+            metrics["pdhg_iters_mean"] = out["pdhg_iters_mean"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
             extra={"solver_path": out.get("solver_path"),
-                   "mfu": out.get("mfu")}))
+                   "mfu": out.get("mfu"),
+                   "algorithm": out.get("pdlp_algorithm")}))
     except Exception as exc:
         print(f"bench ledger warning: {exc}", file=sys.stderr)
 
@@ -309,8 +329,13 @@ def run_bench():
     }
     _, nlp = wind_battery_pricetaker_nlp(T, params_in)
 
-    # LP fast path: restarted PDHG in float32 — the TPU-native solver
-    # (f64 is software-emulated on TPU and ~90x slower; see pdlp.py).
+    # LP fast path: PDHG in float32 — the TPU-native solver (f64 is
+    # software-emulated on TPU and ~90x slower; see pdlp.py).  The
+    # algorithm (reflected-Halpern by default, avg via options or
+    # DISPATCHES_TPU_PDLP_ALGO) is tagged in the output + ledger.
+    from dispatches_tpu.solvers.pdlp import resolve_pdlp_algorithm
+
+    pdlp_algorithm = resolve_pdlp_algorithm(None)
     solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-5, dtype="float32"))
 
     params = nlp.default_params()
@@ -405,6 +430,7 @@ def run_bench():
 
     out = {
         "backend": backend,
+        "pdlp_algorithm": pdlp_algorithm,
         "solver_path": solver_path,
         "baseline": "serial scipy-HiGHS per scenario (IPOPT-class), "
                     "independent reference-formulation assembly",
@@ -459,6 +485,37 @@ def run_bench():
         unit="solves/s",
         vs_baseline=round(peak_sps * serial_per_solve, 2),
     )
+
+    # ---- pdlp variant A/B: restarted-averaged vs reflected-Halpern
+    # PDHG on the same batch-366 workload — the direct evidence for the
+    # solver upgrade (ISSUE 6 acceptance: halpern iters <= 0.5x avg at
+    # unchanged obj_rel_err_vs_highs).  Both variants run through
+    # make_sweep so iteration stats are recorded identically -----------
+    try:
+        variants = {}
+        for algo_ in ("avg", "halpern"):
+            vfn = jax.jit(jax.vmap(make_pdlp_solver(
+                nlp, PDLPOptions(tol=1e-5, dtype="float32",
+                                 algorithm=algo_)), in_axes=in_axes))
+            sw_v = make_sweep(N_SCENARIOS, vfn)
+            objs_v = sw_v(lmps, cfs)  # compile + solve
+            t0 = time.perf_counter()
+            sw_v(lmps, cfs)
+            per_v = time.perf_counter() - t0
+            err_v = float(np.max(np.abs(objs_v[:n_serial] - ref_objs)
+                                 / np.maximum(np.abs(ref_objs), 1.0)))
+            variants[algo_] = {
+                "pdhg_iters_mean": round(
+                    float(np.mean(sw_v.stats["iters"])), 1),
+                "solves_per_sec": round(N_SCENARIOS / per_v, 2),
+                "obj_rel_err_vs_highs": round(err_v, 8),
+            }
+        variants["iters_ratio_halpern_vs_avg"] = round(
+            variants["halpern"]["pdhg_iters_mean"]
+            / max(variants["avg"]["pdhg_iters_mean"], 1.0), 4)
+        out["pdlp_variant"] = variants
+    except Exception as exc:  # telemetry must never kill the headline
+        out["pdlp_variant_error"] = str(exc)[:120]
 
     # ---- serve-layer overhead: N staggered single requests through
     # the micro-batching SolveService vs the same N solved as one

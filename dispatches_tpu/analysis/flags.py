@@ -54,6 +54,9 @@ REGISTERED_FLAGS = {
     "OBS_LEDGER_TOL": "perf-ledger regression tolerance as a fraction "
     "of the trailing-window median (obs.ledger --check-regressions; "
     "default 0.3)",
+    "PDLP_ALGO": "override PDLPOptions.algorithm ('avg' | 'halpern') "
+    "for every PDLP consumer (solvers.pdlp.resolve_pdlp_algorithm; "
+    "read at solver-build time)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

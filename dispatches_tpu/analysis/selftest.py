@@ -150,6 +150,7 @@ CORPUS: Dict[str, Dict[str, str]] = {
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_CHUNK")
             prof = os.environ.get("DISPATCHES_TPU_OBS_PROFILE")
             led_dir = os.environ.get("DISPATCHES_TPU_OBS_LEDGER_DIR")
+            algo = os.environ.get("DISPATCHES_TPU_PDLP_ALGO")
         """,
     },
 }

@@ -17,8 +17,13 @@ Gated metrics and their directions:
 
 * ``solves_per_sec`` — higher is better; regression when the latest
   falls below ``median * (1 - tol)``;
-* ``compile_count`` and ``peak_bytes`` — lower is better; regression
-  when the latest exceeds ``median * (1 + tol)``.
+* ``compile_count``, ``peak_bytes`` and ``pdhg_iters_mean`` — lower is
+  better; regression when the latest exceeds ``median * (1 + tol)``.
+  ``pdhg_iters_mean`` is the direct guardrail for the reflected-Halpern
+  solver upgrade: records carry the solver ``algorithm`` tag in
+  ``extra``, and since the workload fingerprint keys the group, an
+  algorithm change that silently re-inflates iteration counts trips the
+  gate even when wall-clock noise hides it.
 
 Tolerance comes from ``DISPATCHES_TPU_OBS_LEDGER_TOL`` (default 0.3 —
 wide enough for shared-CI noise, tight enough to catch a 2x cliff).
@@ -69,6 +74,7 @@ GATED_METRICS = {
     "solves_per_sec": +1,
     "compile_count": -1,
     "peak_bytes": -1,
+    "pdhg_iters_mean": -1,
 }
 
 _GIT_SHA: Optional[str] = None

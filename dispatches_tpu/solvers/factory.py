@@ -18,7 +18,11 @@ import weakref
 
 from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
-from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+from dispatches_tpu.solvers.pdlp import (
+    PDLPOptions,
+    make_pdlp_solver,
+    resolve_pdlp_algorithm,
+)
 
 
 class NLPKeyedCache:
@@ -120,10 +124,14 @@ class _PDLPSolver:
             lp_kw.setdefault("tol", 1e-8)
             lp_kw.setdefault("dtype", "float64")
             try:
+                # resolve once, at build time (env override included),
+                # so tee reports the algorithm the cached solver runs
+                algo = resolve_pdlp_algorithm(lp_kw.get("algorithm"))
                 kind_solver = (
                     "pdlp",
                     graft_jit(make_pdlp_solver(nlp, PDLPOptions(**lp_kw)),
                               label="factory.pdlp"),
+                    algo,
                 )
             except ValueError:  # not affine: hand off to the NLP kernel
                 if tee:
@@ -139,9 +147,10 @@ class _PDLPSolver:
                         ),
                         label="factory.pdlp_ipm_fallback",
                     ),
+                    None,
                 )
             self._cache.set(nlp, key, kind_solver)
-        kind, solver = kind_solver
+        kind, solver, algo = kind_solver
         if kind == "ipm":
             res = solver(params) if x0 is None else solver(params, x0)
             if tee:
@@ -157,7 +166,7 @@ class _PDLPSolver:
         res = solver(params)
         if tee:
             print(
-                f"[dispatches_tpu.pdlp] iters={int(res.iters)} "
+                f"[dispatches_tpu.pdlp] algo={algo} iters={int(res.iters)} "
                 f"pr={float(res.pr_err):.3e} du={float(res.du_err):.3e} "
                 f"gap={float(res.gap):.3e} converged={bool(res.converged)} "
                 f"obj={float(res.obj):.8g}"

@@ -24,15 +24,27 @@ solve, so one compiled solver sweeps an LMP-scenario batch under
 
 from __future__ import annotations
 
+import os
 import warnings
 from dataclasses import dataclass
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import nan_guard
+
+PDLP_ALGORITHMS = ("avg", "halpern")
+
+# The reflected operator 2T(w) - w is nonexpansive only while
+# tau * sigma * |A|^2 < 1 holds STRICTLY, and the power-iteration
+# estimate of |A| converges from below — so the halpern path shrinks
+# both steps by a safety margin.  Measured on the wind+battery LP
+# batch: 1.0 → 25% of lanes diverge-then-recover (conv 0.75);
+# 0.98 → all lanes converge, and smaller factors only add iterations.
+_HALPERN_STEP_SCALE = 0.98
 
 
 class LPResult(NamedTuple):
@@ -51,6 +63,66 @@ class LPResult(NamedTuple):
 
 @dataclass(frozen=True)
 class PDLPOptions:
+    """Options shared by both LP algorithms (``make_pdlp_solver`` and the
+    batch-native ``make_pdlp_batch_solver``).
+
+    ``algorithm`` selects the iteration scheme:
+
+    * ``"halpern"`` (default) — **reflected Halpern PDHG** (r²HPDHG, the
+      MPAX/cuPDLP-family scheme): each step applies the reflected PDHG
+      operator ``2T(w) - w`` and pulls the iterate back toward the
+      restart anchor with weight ``(k+1)/(k+2)`` (``k`` = steps since
+      the last restart), with restart-to-current-iterate adaptive
+      restarts.  On top of the Ruiz equilibration it applies one
+      Pock–Chambolle diagonal scaling pass (see ``pock_chambolle``).
+      Order-of-magnitude fewer iterations than ``"avg"`` on the LP
+      benchmarks this repo targets.
+    * ``"avg"`` — the original restarted *averaged* PDHG (PDLP-style):
+      the restart/termination candidate is the better of the current
+      iterate and the in-epoch running average.  Kept for A/B runs
+      (bench's ``pdlp_variant`` section) and the perf ledger.
+
+    The ``DISPATCHES_TPU_PDLP_ALGO`` environment flag overrides
+    ``algorithm`` at solver-build time for every consumer (factory,
+    serve, sweep, bench) without touching options plumbing.
+
+    Knobs shared by both algorithms:
+
+    * ``tol`` — relative KKT tolerance; a lane converges when all three
+      errors (primal, dual, gap) fall below it.
+    * ``check_every`` — PDHG iterations per fused sweep between two
+      restart/termination checks.  Both algorithms only observe KKT
+      errors, restart, and terminate on these boundaries, so reported
+      ``iters`` are multiples of it.
+    * ``restart_beta`` — sufficient-decay factor: a restart fires when
+      the candidate KKT error drops below ``restart_beta * e_restart``
+      (the error at the previous restart).  Applies to both algorithms;
+      an "artificial" restart additionally fires when the current epoch
+      exceeds ``max(0.36 * total_iters, floor)`` steps, where the floor
+      is ``8 * check_every`` for ``"avg"`` (the running average needs a
+      window to be worth restarting to) but a single ``check_every``
+      for ``"halpern"`` (re-anchoring is free, and early re-anchors
+      stop the Halpern weights from dragging lanes back toward a stale
+      initial anchor).
+    * ``omega0`` — primal-weight fallback when the ``|b|/|c|``
+      initialization is degenerate; the weight rebalances from observed
+      primal/dual travel on every restart boundary (both algorithms).
+    * ``polish`` — guarded active-set crossover on the final iterate
+      (per-scenario solver only): identifies the optimal face from the
+      f32 PDHG solution and re-solves the active linear system (f32
+      normal equations on the MXU, f64 factor + one iterative-refinement
+      step), lifting the f32 fixed point (~1e-4 objective error) to
+      ~1e-7 for ~4% extra FLOPs.  The polished point is kept only if its
+      KKT error does not regress.  REQUIRES ``jax_enable_x64``: with x64
+      off (e.g. ``DISPATCHES_TPU_NO_X64``) every ``astype(float64)``
+      silently degrades to f32 and the crossover adds FLOPs without
+      accuracy — ``make_pdlp_solver`` warns and the KKT guard keeps the
+      result sound.
+    * ``stall_min_iters`` — earliest iteration at which the stall
+      ("floored") exit may fire; an early 12-check plateau is a
+      pre-restart lull, not the f32 floor.
+    """
+
     tol: float = 1e-6            # relative KKT tolerance (all three errs)
     max_iter: int = 20000
     check_every: int = 40        # iterations between restart/term checks
@@ -59,26 +131,15 @@ class PDLPOptions:
     dtype: str = "float32"       # f32 is the TPU-native fast path; tests
     #                              on CPU may pick float64 for tight parity
     omega0: float = 1.0          # initial primal weight
-    polish: bool = False         # active-set crossover on the final
-    #                              iterate: identifies the vertex from the
-    #                              f32 PDHG solution and re-solves the
-    #                              active linear system (f32 normal
-    #                              equations, f64 factor + one iterative-
-    #                              refinement step) — lifts the f32 fixed
-    #                              point (~1e-4 objective error) to ~1e-7
-    #                              for ~4% extra FLOPs.  Guarded: the
-    #                              polished point is kept only if its KKT
-    #                              error does not regress.  REQUIRES
-    #                              jax_enable_x64: with x64 off (e.g.
-    #                              DISPATCHES_TPU_NO_X64) every astype
-    #                              (float64) silently degrades to f32,
-    #                              the refinement step refines nothing,
-    #                              and the crossover adds FLOPs without
-    #                              accuracy — make_pdlp_solver warns and
-    #                              the KKT guard keeps the result sound.
+    polish: bool = False         # guarded crossover; see class docstring
     polish_act_tol: float = 1e-3  # relative activity threshold
-    stall_min_iters: int = 2400  # earliest iteration at which the
-    #                              stall ("floored") exit may fire
+    stall_min_iters: int = 2400  # earliest stall-exit iteration
+    algorithm: str = "halpern"   # "halpern" (r²HPDHG) | "avg"; see
+    #                              class docstring + DISPATCHES_TPU_PDLP_ALGO
+    pock_chambolle: bool = None  # Pock–Chambolle diagonal scaling pass
+    #                              after Ruiz; None = auto (on for
+    #                              "halpern", off for "avg" so the A/B
+    #                              baseline stays bit-stable)
 
 
 def _ruiz_equilibrate(A, iters):
@@ -96,6 +157,53 @@ def _ruiz_equilibrate(A, iters):
         dc /= cn
         Ah = dr[:, None] * A * dc[None, :]
     return dr, dc
+
+
+def _pock_chambolle(A, alpha=1.0):
+    """Pock–Chambolle diagonal preconditioning as a scaling pass
+    (cuPDLP/MPAX pipeline: Ruiz iterations, then one PC pass): returns
+    (D_r, D_c) with D_r = diag(1/sqrt(row alpha-norms^alpha)) and
+    D_c = diag(1/sqrt(col (2-alpha)-norms^(2-alpha))); alpha=1 gives the
+    classic 1-norm variant.  Computed once on the host in f64."""
+    absA = np.abs(A)
+    r = np.power(absA, alpha).sum(axis=1)
+    c = np.power(absA, 2.0 - alpha).sum(axis=0)
+    dr = 1.0 / np.sqrt(np.maximum(r, 1e-12))
+    dc = 1.0 / np.sqrt(np.maximum(c, 1e-12))
+    return dr, dc
+
+
+def resolve_pdlp_algorithm(algorithm: Optional[str] = None) -> str:
+    """Effective PDLP algorithm: the ``DISPATCHES_TPU_PDLP_ALGO``
+    environment override when set, else ``algorithm``, else the
+    :class:`PDLPOptions` default.  Shared by both solver builders and
+    the bench/sweep ledger tagging so every consumer resolves the same
+    way."""
+    algo = (os.environ.get(flag_name("PDLP_ALGO"), "")
+            or algorithm or PDLPOptions.algorithm).lower()
+    if algo not in PDLP_ALGORITHMS:
+        raise ValueError(
+            f"unknown PDLP algorithm {algo!r}; expected one of "
+            f"{PDLP_ALGORITHMS} (check DISPATCHES_TPU_PDLP_ALGO)"
+        )
+    return algo
+
+
+def _scalings(A, opt):
+    """The full preconditioning pipeline for one LP shape bucket: Ruiz
+    equilibration, then (for the Halpern path, or when forced via
+    ``opt.pock_chambolle``) one Pock–Chambolle diagonal pass on the
+    equilibrated matrix.  Returns (dr, dc, Ah, algo)."""
+    algo = resolve_pdlp_algorithm(opt.algorithm)
+    dr, dc = _ruiz_equilibrate(A, opt.ruiz_iters)
+    use_pc = (opt.pock_chambolle if opt.pock_chambolle is not None
+              else algo == "halpern")
+    if use_pc:
+        Ah = dr[:, None] * A * dc[None, :]
+        dr2, dc2 = _pock_chambolle(Ah)
+        dr, dc = dr * dr2, dc * dc2
+    Ah = dr[:, None] * A * dc[None, :]
+    return dr, dc, Ah, algo
 
 
 def _power_norm(A, iters=60):
@@ -177,8 +285,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
     n = nlp.n
 
     A = np.vstack([K, G]) if m_in else K
-    dr, dc = _ruiz_equilibrate(A, opt.ruiz_iters)
-    Ah = dr[:, None] * A * dc[None, :]
+    dr, dc, Ah, algo = _scalings(A, opt)
     norm_A = max(_power_norm(Ah), 1e-12)
 
     Ah_raw = jnp.asarray(Ah, dtype)
@@ -309,6 +416,37 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
         (x, z, xs, zs), _ = jax.lax.scan(body, (x, z, xs, zs), None, length=k)
         return x, z, xs, zs
 
+    def _halpern_sweep(x, z, xa, za, xs, zs, c, b, omega, k0, k):
+        """k reflected-Halpern PDHG steps anchored at (xa, za):
+        w_{j+1} = (j+1)/(j+2) * (2 T(w_j) - w_j) + 1/(j+2) * anchor,
+        with j = k0 + step counting from the last restart.  Returns the
+        final reflected iterate (x, z), the last operator output
+        (xt, zt) — a feasible candidate (the reflected iterate itself
+        may sit outside the box) — and the accumulated operator-output
+        sums (xs, zs) whose epoch average is the second candidate the
+        restart/termination checks evaluate.  The averaged candidate
+        matters at the f32 KKT floor: individual operator outputs carry
+        rounding noise ~|A| eps |x| that the in-epoch mean smooths out
+        (measured: one battery-LP lane floors at 1.03e-5 on the last
+        iterate but passes tol=1e-5 on the average)."""
+        tau = omega * inv_step * _HALPERN_STEP_SCALE
+        sig = inv_step / omega * _HALPERN_STEP_SCALE
+
+        def body(carry, j):
+            x, z, _, _, xs, zs = carry
+            xt = jnp.clip(x - tau * (c + ATmv(z)), lb_h, ub_h)
+            z_t = z + sig * (Amv(2.0 * xt - x) - b)
+            zt = jnp.where(is_eq, z_t, jnp.clip(z_t, 0.0, None))
+            w = ((j + 1.0) / (j + 2.0)).astype(dtype)
+            xn = w * (2.0 * xt - x) + (1.0 - w) * xa
+            zn = w * (2.0 * zt - z) + (1.0 - w) * za
+            return (xn, zn, xt, zt, xs + xt, zs + zt), None
+
+        steps = k0 + jnp.arange(k, dtype=jnp.int32)
+        (x, z, xt, zt, xs, zs), _ = jax.lax.scan(
+            body, (x, z, x, z, xs, zs), steps)
+        return x, z, xt, zt, xs, zs
+
     def solver(params) -> LPResult:
         c, b = _rhs(params)
         x = jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h)
@@ -335,7 +473,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
         def cond(s):
             return jnp.logical_and(s["it"] < opt.max_iter, ~s["done"])
 
-        def step(s):
+        def step_avg(s):
             x1, z1, xs, zs = _pdhg_sweep(
                 s["x"], s["z"], s["xs"], s["zs"], c, b, s["omega"], opt.check_every
             )
@@ -435,12 +573,117 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
                 out["gap_b"] = jnp.where(new_best, gap_c, s["gap_b"])
             return out
 
+        def step_halpern(s):
+            x1, z1, xt, zt, xts, zts = _halpern_sweep(
+                s["x"], s["z"], s["xs"], s["zs"], s["xts"], s["zts"],
+                c, b, s["omega"], s["k"], opt.check_every
+            )
+            nan_guard("pdlp.iterate", x1, z1)
+            k = s["k"] + opt.check_every
+            # two candidates, like the avg path: the last operator
+            # output (feasible) and the in-epoch mean of operator
+            # outputs — the mean wins at the f32 KKT floor, where the
+            # last iterate's rounding noise can sit just above tol
+            xa_c, za_c = xts / k, zts / k
+            e_cur, k_cur = err_of(xt, zt)
+            e_avg, k_avg = err_of(xa_c, za_c)
+            use_avg = e_avg < e_cur
+            xc = jnp.where(use_avg, xa_c, xt)
+            zc = jnp.where(use_avg, za_c, zt)
+            e_c = jnp.minimum(e_avg, e_cur)
+
+            # restart-to-current-iterate: same sufficient-decay /
+            # artificial criteria as the avg path, but a restart
+            # re-anchors the Halpern sequence at the candidate.  The
+            # artificial floor is one check interval, not the avg
+            # path's eight: re-anchoring is free here (no average to
+            # rebuild), and the Halpern weights pull hard toward a
+            # stale anchor — lanes measurably stall near the initial
+            # point until the first re-anchor fires.
+            sufficient = e_c <= opt.restart_beta * s["e_r"]
+            artificial = k >= jnp.maximum(0.36 * s["it"], opt.check_every)
+            do_restart = jnp.logical_or(sufficient, artificial)
+
+            dx = _inf(xc - s["xr"])
+            dz = _inf(zc - s["zr"])
+            omega_new = jnp.clip(
+                jnp.exp(
+                    0.5 * jnp.log(s["omega"])
+                    + 0.5 * jnp.log(jnp.maximum(dx, 1e-10)
+                                    / jnp.maximum(dz, 1e-10))
+                ),
+                1e-6,
+                1e8,
+            )
+            omega = jnp.where(do_restart, omega_new, s["omega"])
+            xr = jnp.where(do_restart, xc, s["xr"])
+            zr = jnp.where(do_restart, zc, s["zr"])
+            e_r = jnp.where(do_restart, e_c, s["e_r"])
+            x_next = jnp.where(do_restart, xc, x1)
+            z_next = jnp.where(do_restart, zc, z1)
+
+            # best-iterate tracking + stall exit: identical to the avg
+            # path (one floored f32 lane must not drag a vmapped batch
+            # to max_iter)
+            improved = e_c < 0.95 * s["e_b"]
+            new_best = e_c < s["e_b"]
+            e_b = jnp.where(new_best, e_c, s["e_b"])
+            xb = jnp.where(new_best, xc, s["xb"])
+            zb = jnp.where(new_best, zc, s["zb"])
+            stall = jnp.where(improved, 0, s["stall"] + 1)
+            floored = jnp.logical_and(
+                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
+                s["it"] >= opt.stall_min_iters,
+            )
+            done = jnp.logical_or(
+                s["done"], jnp.logical_or(e_b < opt.tol, floored)
+            )
+            out = {
+                "x": x_next,
+                "z": z_next,
+                # on this path xs/zs carry the Halpern ANCHOR (a restart
+                # re-anchors at the candidate) and xts/zts the in-epoch
+                # operator-output sums (a restart zeroes them)
+                "xs": jnp.where(do_restart, xc, s["xs"]),
+                "zs": jnp.where(do_restart, zc, s["zs"]),
+                "xts": jnp.where(do_restart, jnp.zeros_like(xt), xts),
+                "zts": jnp.where(do_restart, jnp.zeros_like(zt), zts),
+                "k": jnp.where(do_restart, 0, k),
+                "xr": xr,
+                "zr": zr,
+                "e_r": e_r,
+                "omega": omega,
+                "it": s["it"] + opt.check_every,
+                "done": done,
+                "e_b": e_b,
+                "stall": stall,
+                "xb": xb,
+                "zb": zb,
+            }
+            if trace:
+                pr_c = jnp.where(use_avg, k_avg[0], k_cur[0])
+                du_c = jnp.where(use_avg, k_avg[1], k_cur[1])
+                gap_c = jnp.where(use_avg, k_avg[2], k_cur[2])
+                out["e_c"] = e_c
+                out["pr_b"] = jnp.where(new_best, pr_c, s["pr_b"])
+                out["du_b"] = jnp.where(new_best, du_c, s["du_b"])
+                out["gap_b"] = jnp.where(new_best, gap_c, s["gap_b"])
+            return out
+
+        step = step_halpern if algo == "halpern" else step_avg
+
         init = {
             "x": x,
             "z": z,
-            "xs": jnp.zeros_like(x),
-            "zs": jnp.zeros_like(z),
+            # avg: running sums (start at 0); halpern: anchor (start at
+            # the initial point)
+            "xs": x if algo == "halpern" else jnp.zeros_like(x),
+            "zs": z if algo == "halpern" else jnp.zeros_like(z),
             "k": jnp.asarray(0, jnp.int32),
+            # halpern-only: in-epoch operator-output sums (second
+            # candidate); the avg path's sums live in xs/zs above
+            **({"xts": jnp.zeros_like(x), "zts": jnp.zeros_like(z)}
+               if algo == "halpern" else {}),
             "xr": x,
             "zr": z,
             "e_r": e0,

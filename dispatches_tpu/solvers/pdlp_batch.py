@@ -12,8 +12,12 @@ per-lane iterates resident in VMEM for the whole sweep (HBM sees one
 read and one write of the state per sweep instead of one per step).
 
 The restart/termination logic between sweeps is identical to pdlp.py's
-(averaging, PDLP sufficient-decay + artificial restarts, primal-weight
-rebalancing, best-iterate stall exit), evaluated vectorized over lanes.
+(averaging or Halpern anchoring per ``options.algorithm``, PDLP
+sufficient-decay + artificial restarts, primal-weight rebalancing,
+best-iterate stall exit), evaluated vectorized over lanes.  Both
+algorithms get their own fused Pallas sweep kernel; the reflected
+Halpern one additionally carries the per-lane anchor and step counter
+through VMEM (lanes restart — and hence re-anchor — independently).
 
 ``sweep="pallas"`` requires a TPU (or ``interpret=True`` for CPU
 correctness tests); ``sweep="xla"`` is the portable fallback with the
@@ -33,10 +37,11 @@ import numpy as np
 
 from dispatches_tpu.analysis.runtime import nan_guard
 from dispatches_tpu.solvers.pdlp import (
+    _HALPERN_STEP_SCALE,
     LPResult,
     PDLPOptions,
     _power_norm,
-    _ruiz_equilibrate,
+    _scalings,
     make_lp_data,
 )
 
@@ -163,6 +168,138 @@ def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
     return sweep
 
 
+def _pallas_halpern_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
+                             interpret):
+    """Build ``sweep(x, z, xa, za, xs, zs, c, b, tau, sig, k0) ->
+    (x, z, xt, zt, xs, zs)`` running ``k`` reflected-Halpern PDHG steps
+    fused in one Pallas kernel (same layout as :func:`_pallas_sweep_fn`).
+
+    ``(xa, za)`` is the per-lane Halpern anchor and ``k0`` the per-lane
+    float step count since that lane's last restart — lanes restart
+    independently, so the anchor pull-back weight (k0+i+1)/(k0+i+2)
+    differs per lane within one fused sweep.  Returns the reflected
+    iterate, the last operator output ``(xt, zt)`` (a feasible
+    candidate), and the accumulated operator-output sums ``(xs, zs)``
+    whose in-epoch mean is the second termination/restart candidate —
+    it smooths the f32 rounding noise that can pin a lane's last
+    iterate just above tol (see pdlp.py:_halpern_sweep)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, n = Ah.shape
+    dtype = Ah.dtype
+    lb_row = jnp.asarray(lb, dtype)[None, :]
+    ub_row = jnp.asarray(ub, dtype)[None, :]
+    eq_row = jnp.asarray(is_eq_f, dtype)[None, :]
+
+    def kernel(Ah_ref, AhT_ref, lb_ref, ub_ref, eq_ref,
+               c_ref, b_ref, tau_ref, sig_ref, k0_ref,
+               x_ref, z_ref, xa_ref, za_ref, xs_ref, zs_ref,
+               x_out, z_out, xt_out, zt_out, xs_out, zs_out):
+        A = Ah_ref[:]
+        AT = AhT_ref[:]
+        lb_r = lb_ref[:]
+        ub_r = ub_ref[:]
+        eq_r = eq_ref[:]
+        c = c_ref[:]
+        b = b_ref[:]
+        tau = tau_ref[:]
+        sig = sig_ref[:]
+        k0 = k0_ref[:]
+        xa = xa_ref[:]
+        za = za_ref[:]
+
+        # full-f32 MXU passes — same rationale as _pallas_sweep_fn
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=dtype,
+        )
+
+        def body(i, carry):
+            x, z, _, _, xs, zs = carry
+            xt = jnp.clip(x - tau * (c + dot(z, A)), lb_r, ub_r)
+            z_t = z + sig * (dot(2.0 * xt - x, AT) - b)
+            zt = eq_r * z_t + (1.0 - eq_r) * jnp.maximum(z_t, 0.0)
+            j = k0 + i.astype(dtype)          # (lanes, 1) per-lane count
+            w = (j + 1.0) / (j + 2.0)
+            xn = w * (2.0 * xt - x) + (1.0 - w) * xa
+            zn = w * (2.0 * zt - z) + (1.0 - w) * za
+            return xn, zn, xt, zt, xs + xt, zs + zt
+
+        x, z, xt, zt, xs, zs = jax.lax.fori_loop(
+            0, k, body,
+            (x_ref[:], z_ref[:], x_ref[:], z_ref[:], xs_ref[:], zs_ref[:])
+        )
+        x_out[:] = x
+        z_out[:] = z
+        xt_out[:] = xt
+        zt_out[:] = zt
+        xs_out[:] = xs
+        zs_out[:] = zs
+
+    def sweep(x, z, xa, za, xs, zs, c, b, tau, sig, k0):
+        B0 = x.shape[0]
+        lb_blk = min(lanes_per_block, B0)
+        pad = (-B0) % lb_blk
+        if pad:  # padded lanes (tau=sig=0) stay finite and are dropped
+            zp = lambda a: jnp.concatenate(  # noqa: E731
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+            x, z, xa, za, xs, zs = (zp(x), zp(z), zp(xa), zp(za),
+                                    zp(xs), zp(zs))
+            c, b, tau, sig, k0 = zp(c), zp(b), zp(tau), zp(sig), zp(k0)
+        B = B0 + pad
+        grid = (B // lb_blk,)
+
+        def lane_spec(width):
+            return pl.BlockSpec((lb_blk, width), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+
+        full = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+        out_shapes = [
+            jax.ShapeDtypeStruct((B, n), dtype),
+            jax.ShapeDtypeStruct((B, m), dtype),
+            jax.ShapeDtypeStruct((B, n), dtype),
+            jax.ShapeDtypeStruct((B, m), dtype),
+            jax.ShapeDtypeStruct((B, n), dtype),
+            jax.ShapeDtypeStruct((B, m), dtype),
+        ]
+        call = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                full((m, n), lambda i: (0, 0)),
+                full((n, m), lambda i: (0, 0)),
+                full((1, n), lambda i: (0, 0)),   # lb
+                full((1, n), lambda i: (0, 0)),   # ub
+                full((1, m), lambda i: (0, 0)),   # eq mask
+                lane_spec(n),   # c
+                lane_spec(m),   # b
+                lane_spec(1),   # tau
+                lane_spec(1),   # sig
+                lane_spec(1),   # k0 (float steps since lane restart)
+                lane_spec(n),   # x
+                lane_spec(m),   # z
+                lane_spec(n),   # xa (anchor)
+                lane_spec(m),   # za (anchor)
+                lane_spec(n),   # xs (operator-output sums)
+                lane_spec(m),   # zs (operator-output sums)
+            ],
+            out_specs=[lane_spec(n), lane_spec(m), lane_spec(n),
+                       lane_spec(m), lane_spec(n), lane_spec(m)],
+            out_shape=out_shapes,
+            interpret=interpret,
+        )
+        out = call(Ah, AhT, lb_row, ub_row, eq_row, c, b, tau, sig, k0,
+                   x, z, xa, za, xs, zs)
+        if pad:
+            out = tuple(a[:B0] for a in out)
+        return out
+
+    return sweep
+
+
 def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
                            lp_data=None):
     """Build ``solver(batched_params) -> LPResult`` where every leaf of
@@ -189,8 +326,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
     m = m_eq + m_in
 
     A = np.vstack([K, G]) if m_in else K
-    dr, dc = _ruiz_equilibrate(A, opt.ruiz_iters)
-    Ah = dr[:, None] * A * dc[None, :]
+    dr, dc, Ah, algo = _scalings(A, opt)
     norm_A = max(_power_norm(Ah), 1e-12)
 
     Ah_j = jnp.asarray(Ah, dtype)
@@ -207,10 +343,34 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
     use_pallas = opt.sweep == "pallas" or (
         opt.sweep == "auto" and jax.devices()[0].platform == "tpu"
     )
-    if use_pallas:
+    if use_pallas and algo == "halpern":
+        sweep = _pallas_halpern_sweep_fn(Ah_j, AhT_j, lb_h, ub_h, is_eq_f,
+                                         opt.check_every,
+                                         opt.lanes_per_block, opt.interpret)
+    elif use_pallas:
         sweep = _pallas_sweep_fn(Ah_j, AhT_j, lb_h, ub_h, is_eq_f,
                                  opt.check_every, opt.lanes_per_block,
                                  opt.interpret)
+    elif algo == "halpern":
+        def sweep(x, z, xa, za, xs, zs, c, b, tau, sig, k0):
+            def body(carry, i):
+                x, z, _, _, xs, zs = carry
+                grad = c + jnp.matmul(z, Ah_j, precision=_prec)
+                xt = jnp.clip(x - tau * grad, lb_h[None, :], ub_h[None, :])
+                ax = jnp.matmul(2.0 * xt - x, AhT_j, precision=_prec)
+                z_t = z + sig * (ax - b)
+                zt = jnp.where(is_eq[None, :], z_t, jnp.clip(z_t, 0.0, None))
+                j = k0 + i.astype(dtype)      # (B, 1) per-lane step count
+                w = (j + 1.0) / (j + 2.0)
+                xn = w * (2.0 * xt - x) + (1.0 - w) * xa
+                zn = w * (2.0 * zt - z) + (1.0 - w) * za
+                return (xn, zn, xt, zt, xs + xt, zs + zt), None
+
+            (x, z, xt, zt, xs, zs), _ = jax.lax.scan(
+                body, (x, z, x, z, xs, zs),
+                jnp.arange(opt.check_every, dtype=jnp.int32)
+            )
+            return x, z, xt, zt, xs, zs
     else:
         def sweep(x, z, xs, zs, c, b, tau, sig):
             def body(carry, _):
@@ -316,7 +476,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             return jnp.logical_and(s["it"] < opt.max_iter,
                                    ~jnp.all(s["done"]))
 
-        def step(s):
+        def step_avg(s):
             tau = (s["omega"] * inv_step)[:, None]
             sig = (inv_step / s["omega"])[:, None]
             x1, z1, xs, zs = sweep(s["x"], s["z"], s["xs"], s["zs"],
@@ -383,9 +543,94 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
                 "xb": xb, "zb": zb,
             }
 
+        def step_halpern(s):
+            # batched transcription of pdlp.py:step_halpern — per-lane
+            # anchors, step counts, and restarts ([:, None] broadcasts)
+            tau = (s["omega"] * inv_step * _HALPERN_STEP_SCALE)[:, None]
+            sig = (inv_step / s["omega"] * _HALPERN_STEP_SCALE)[:, None]
+            k0 = s["k"].astype(dtype)[:, None]
+            x1, z1, xt, zt, xts, zts = sweep(
+                s["x"], s["z"], s["xs"], s["zs"], s["xts"], s["zts"],
+                c, b, tau, sig, k0)
+            nan_guard("pdlp_batch.iterate", x1, z1)
+            k = s["k"] + opt.check_every
+            # two candidates, like the avg path: last operator output
+            # (feasible) and the in-epoch mean of operator outputs —
+            # the mean smooths f32 rounding noise at the KKT floor
+            # (see pdlp.py:_halpern_sweep)
+            kf = k.astype(dtype)[:, None]
+            xa_c, za_c = xts / kf, zts / kf
+            e_cur = _err(xt, zt, c, b)
+            e_avg = _err(xa_c, za_c, c, b)
+            use_avg = (e_avg < e_cur)[:, None]
+            xc = jnp.where(use_avg, xa_c, xt)
+            zc = jnp.where(use_avg, za_c, zt)
+            e_c = jnp.minimum(e_avg, e_cur)
+
+            # restart-to-current-iterate; the artificial floor is one
+            # check interval (see pdlp.py:step_halpern for why)
+            sufficient = e_c <= opt.restart_beta * s["e_r"]
+            artificial = k >= jnp.maximum(0.36 * s["it"], opt.check_every)
+            do_restart = jnp.logical_or(sufficient, artificial)
+
+            dx = _inf_rows(xc - s["xr"])
+            dz = _inf_rows(zc - s["zr"])
+            omega_new = jnp.clip(
+                jnp.exp(0.5 * jnp.log(s["omega"])
+                        + 0.5 * jnp.log(jnp.maximum(dx, 1e-10)
+                                        / jnp.maximum(dz, 1e-10))),
+                1e-6, 1e8)
+            omega = jnp.where(do_restart, omega_new, s["omega"])
+            xr = jnp.where(do_restart[:, None], xc, s["xr"])
+            zr = jnp.where(do_restart[:, None], zc, s["zr"])
+            e_r = jnp.where(do_restart, e_c, s["e_r"])
+            x_next = jnp.where(do_restart[:, None], xc, x1)
+            z_next = jnp.where(do_restart[:, None], zc, z1)
+
+            improved = e_c < 0.95 * s["e_b"]
+            new_best = e_c < s["e_b"]
+            e_b = jnp.where(new_best, e_c, s["e_b"])
+            xb = jnp.where(new_best[:, None], xc, s["xb"])
+            zb = jnp.where(new_best[:, None], zc, s["zb"])
+            stall = jnp.where(improved, 0, s["stall"] + 1)
+            floored = jnp.logical_and(
+                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
+                s["it"] >= opt.stall_min_iters,
+            )
+            done = jnp.logical_or(s["done"],
+                                  jnp.logical_or(e_b < opt.tol, floored))
+            it_next = s["it"] + opt.check_every
+            it_done = jnp.where(jnp.logical_and(done, ~s["done"]),
+                                it_next, s["it_done"])
+            zero = do_restart[:, None]
+            return {
+                "x": x_next, "z": z_next,
+                # xs/zs carry the per-lane Halpern ANCHOR (a restart
+                # re-anchors the lane at its candidate); xts/zts the
+                # in-epoch operator-output sums (a restart zeroes them)
+                "xs": jnp.where(zero, xc, s["xs"]),
+                "zs": jnp.where(zero, zc, s["zs"]),
+                "xts": jnp.where(zero, jnp.zeros_like(xt), xts),
+                "zts": jnp.where(zero, jnp.zeros_like(zt), zts),
+                "k": jnp.where(do_restart, 0, k),
+                "xr": xr, "zr": zr, "e_r": e_r, "omega": omega,
+                "it": it_next, "it_done": it_done,
+                "done": done, "e_b": e_b, "stall": stall,
+                "xb": xb, "zb": zb,
+            }
+
+        step = step_halpern if algo == "halpern" else step_avg
+
         init = {
             "x": x, "z": z,
-            "xs": jnp.zeros_like(x), "zs": jnp.zeros_like(z),
+            # avg: running sums (start at 0); halpern: per-lane anchor
+            # (start at the initial point)
+            "xs": x if algo == "halpern" else jnp.zeros_like(x),
+            "zs": z if algo == "halpern" else jnp.zeros_like(z),
+            # halpern-only: in-epoch operator-output sums (second
+            # candidate); the avg path's sums live in xs/zs above
+            **({"xts": jnp.zeros_like(x), "zts": jnp.zeros_like(z)}
+               if algo == "halpern" else {}),
             "k": jnp.zeros(B, jnp.int32),
             "xr": x, "zr": z, "e_r": e0, "omega": omega0,
             "it": jnp.asarray(0, jnp.int32),

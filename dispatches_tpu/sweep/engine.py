@@ -264,6 +264,20 @@ def _ledger_record(store: ResultStore, opts: "SweepOptions",
         metrics: Dict = {}
         if s.get("solves_per_sec") is not None:
             metrics["solves_per_sec"] = s["solves_per_sec"]
+        # gated iteration-count guardrail for the PDLP solver upgrades
+        algorithm = None
+        if str(opts.solver).lower() == "pdlp":
+            if s.get("iterations_mean") is not None:
+                metrics["pdhg_iters_mean"] = s["iterations_mean"]
+            try:
+                from dispatches_tpu.solvers.pdlp import (
+                    resolve_pdlp_algorithm,
+                )
+
+                algorithm = resolve_pdlp_algorithm(
+                    (opts.solver_options or {}).get("algorithm"))
+            except Exception:
+                pass
         counter = getattr(solve_chunk, "_graft_counter", None)
         if counter is not None:
             metrics["compile_count"] = int(counter.count)
@@ -283,7 +297,8 @@ def _ledger_record(store: ResultStore, opts: "SweepOptions",
             "sweep", store.fingerprint[:12], metrics,
             backend=jax.default_backend(),
             extra={"dispatch": opts.backend,
-                   "chunks_done": s.get("chunks_done")}))
+                   "chunks_done": s.get("chunks_done"),
+                   "algorithm": algorithm}))
     except Exception:
         pass
 

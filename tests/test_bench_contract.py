@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r05_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r06_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -38,6 +38,29 @@ def test_preview_record_passes_schema(bench):
         assert key in out["roofline"]
 
 
+def test_preview_pdlp_variant_ab(bench):
+    """The pinned preview carries the avg-vs-halpern A/B section, and
+    the recorded run reproduces the tentpole claim: the reflected-
+    Halpern path needs at most half the averaged-PDHG iterations on
+    the same batch (measured ~0.32x on the CPU preview) while staying
+    inside the 1e-4 objective budget."""
+    out = json.load(open(PREVIEW))
+    variants = out["pdlp_variant"]
+    for algo in ("avg", "halpern"):
+        for key in bench.PDLP_VARIANT_KEYS:
+            assert key in variants[algo], (algo, key)
+        assert variants[algo]["obj_rel_err_vs_highs"] <= 1e-4
+    ratio = (variants["halpern"]["pdhg_iters_mean"]
+             / variants["avg"]["pdhg_iters_mean"])
+    assert ratio <= 0.5
+    assert variants["iters_ratio_halpern_vs_avg"] == pytest.approx(
+        ratio, abs=1e-3)
+    # the headline record runs whatever the resolved default is; it
+    # must say so, and its iteration count feeds the ledger gate
+    assert out["pdlp_algorithm"] in ("avg", "halpern")
+    assert out["pdhg_iters_mean"] > 0
+
+
 def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["vs_baseline"]
@@ -50,6 +73,19 @@ def test_validate_rejects_missing_keys(bench):
     # roofline itself is optional (CPU preview path may omit it)
     out = json.load(open(PREVIEW))
     del out["roofline"]
+    bench.validate_bench_output(out)
+    # pdlp_variant is optional, but when present both algorithms must
+    # carry the full per-variant key set
+    out = json.load(open(PREVIEW))
+    del out["pdlp_variant"]["halpern"]["pdhg_iters_mean"]
+    with pytest.raises(ValueError, match="pdhg_iters_mean"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["pdlp_variant"]["avg"]
+    with pytest.raises(ValueError, match="avg"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["pdlp_variant"]
     bench.validate_bench_output(out)
 
 

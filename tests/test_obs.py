@@ -256,13 +256,17 @@ def test_ipm_trace_mu_monotone_and_bitwise_parity():
     assert "kkt_error" in ct.columns and "iter" in ct.format()
 
 
-def test_pdlp_trace_gap_at_reported_iteration_and_parity():
+@pytest.mark.parametrize("algorithm", ["avg", "halpern"])
+def test_pdlp_trace_gap_at_reported_iteration_and_parity(algorithm):
+    """Both PDLP algorithms: trace=True must not perturb the solve
+    (bitwise x parity) and the trace's best-iterate row at the reported
+    iteration is exactly what the LPResult certifies."""
     from dispatches_tpu.serve.__main__ import _arbitrage_nlp
     from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
 
     nlp = _arbitrage_nlp(6)
     params = nlp.default_params()
-    opts = PDLPOptions(dtype="float64", tol=1e-8)
+    opts = PDLPOptions(dtype="float64", tol=1e-8, algorithm=algorithm)
     res0 = jax.jit(make_pdlp_solver(nlp, opts))(params)
     res1, tr = jax.jit(make_pdlp_solver(nlp, opts, trace=True))(params)
 

@@ -12,6 +12,7 @@ import pytest
 from scipy.optimize import linprog
 
 from dispatches_tpu import Flowsheet
+from dispatches_tpu.analysis.flags import flag_enabled
 from dispatches_tpu.core.graph import tshift
 from dispatches_tpu.solvers import PDLPOptions, make_pdlp_solver
 
@@ -194,6 +195,101 @@ def test_pdlp_batch_duals_parity():
     # shadow-price test above) — per lane, against its OWN lmp row
     np.testing.assert_allclose(np.abs(zb[:, :T]), lmps, atol=1e-5)
     np.testing.assert_allclose(np.abs(zv[:, :T]), lmps, atol=1e-5)
+
+
+@pytest.mark.skipif(not flag_enabled("SLOW"),
+                    reason="slow lane (DISPATCHES_TPU_SLOW=1)")
+def test_pdlp_batch_halpern_lanewise_highs_parity():
+    """Lane-wise HiGHS parity for the reflected-Halpern batch path,
+    mirroring the avg-path f32 parity test above: every lane of the
+    batch-native solver with ``algorithm="halpern"`` meets the 1e-4
+    objective budget against its own independently assembled HiGHS
+    reference.  Slow lane: the tier-1 budget is at its cap, and the
+    vmapped f32 parity test above already covers the halpern default
+    in tier 1 — this adds the batch-native path and per-lane HiGHS
+    references."""
+    from dispatches_tpu.solvers.pdlp_batch import (
+        BatchPDLPOptions,
+        make_pdlp_batch_solver,
+    )
+
+    T = 24
+    nlp = _battery_lp(T)
+    params = nlp.default_params()
+    rng = np.random.default_rng(7)
+    B = 4
+    lmps = 0.02 + 0.015 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (B, 1))) / 24
+    )
+    cfs = 400e3 * (0.4 + 0.6 * rng.random((B, T)))
+    batched = {
+        "p": {"lmp": jnp.asarray(lmps), "wind_cap_cf": jnp.asarray(cfs)},
+        "fixed": params["fixed"],
+    }
+    # stall_min_iters disables the floored-lane early exit (a batch
+    # THROUGHPUT heuristic): this test asserts true lane-wise
+    # convergence to tol, and one seed-7 lane grinds slowly through the
+    # gate's patience window (stall exit at 3.2k iters with the err a
+    # hair above tol) before honestly reaching tol at ~5.3k — while
+    # meeting the 1e-4 objective budget the whole time
+    bs = jax.jit(make_pdlp_batch_solver(
+        nlp, BatchPDLPOptions(tol=1e-5, dtype="float32", sweep="xla",
+                              algorithm="halpern",
+                              stall_min_iters=10**9)))
+    res = bs(batched)
+    assert bool(np.all(np.asarray(res.converged)))
+    objs = np.asarray(res.obj)
+    for i in range(B):
+        ref = _highs_battery(T, lmps[i], cfs[i])
+        assert objs[i] == pytest.approx(ref, rel=1e-4), f"lane {i}"
+
+
+def test_resolve_pdlp_algorithm(monkeypatch):
+    """One resolution rule for every consumer: env override beats the
+    explicit argument beats the PDLPOptions default; junk raises."""
+    from dispatches_tpu.solvers.pdlp import resolve_pdlp_algorithm
+
+    monkeypatch.delenv("DISPATCHES_TPU_PDLP_ALGO", raising=False)
+    assert resolve_pdlp_algorithm() == PDLPOptions.algorithm
+    assert resolve_pdlp_algorithm("avg") == "avg"
+    assert resolve_pdlp_algorithm("Halpern") == "halpern"
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_ALGO", "avg")
+    assert resolve_pdlp_algorithm("halpern") == "avg"
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_ALGO", "newton")
+    with pytest.raises(ValueError, match="newton"):
+        resolve_pdlp_algorithm()
+
+
+@pytest.mark.skipif(not flag_enabled("SLOW"),
+                    reason="slow lane (DISPATCHES_TPU_SLOW=1)")
+def test_pdlp_halpern_cuts_iterations_vs_avg():
+    """The tentpole claim at test scale: reflected-Halpern PDHG
+    (anchoring + Pock-Chambolle scaling + restart-to-current) converges
+    in at most ~half the averaged-PDHG iterations on the same batch, at
+    the same f32 tolerance.  Slow lane (tier-1 budget): the pinned
+    bench preview in test_bench_contract.py asserts the same ratio
+    bound in tier 1 from recorded data."""
+    T = 24
+    nlp = _battery_lp(T)
+    params = nlp.default_params()
+    rng = np.random.default_rng(9)
+    N = 4
+    lmps = 0.02 + 0.015 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (N, 1))) / 24
+    )
+    cfs = 400e3 * (0.4 + 0.6 * rng.random((N, T)))
+    batched = {"p": {"lmp": lmps, "wind_cap_cf": cfs},
+               "fixed": params["fixed"]}
+    axes = ({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},)
+
+    def iters_mean(algo):
+        solver = make_pdlp_solver(
+            nlp, PDLPOptions(tol=1e-5, dtype="float32", algorithm=algo))
+        res = jax.jit(jax.vmap(solver, in_axes=axes))(batched)
+        assert bool(np.all(np.asarray(res.converged))), algo
+        return float(np.mean(np.asarray(res.iters)))
+
+    assert iters_mean("halpern") <= 0.55 * iters_mean("avg")
 
 
 def test_pdlp_polish_warns_without_x64():

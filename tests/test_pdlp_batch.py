@@ -119,6 +119,67 @@ def test_pallas_sweep_matches_xla_sweep(nlp):
                                    rtol=1e-6, atol=1e-6)
 
 
+def test_pallas_halpern_sweep_matches_xla(nlp):
+    """The fused reflected-Halpern kernel reproduces a reference XLA
+    transcription exactly in interpreter mode — including the per-lane
+    anchor pull-back weights (k0 differs per lane, as it does whenever
+    lanes restart at different times)."""
+    from dispatches_tpu.solvers.pdlp_batch import _pallas_halpern_sweep_fn
+
+    data = make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    A = np.vstack([K, G]) if G.shape[0] else K
+    dr, dc = _ruiz_equilibrate(A, 10)
+    Ah = (dr[:, None] * A * dc[None, :]).astype(np.float32)
+    m, n = Ah.shape
+    lb = (data["lb"] / dc).astype(np.float32)
+    ub = (data["ub"] / dc).astype(np.float32)
+    eq = np.concatenate(
+        [np.ones(K.shape[0]), np.zeros(G.shape[0])]).astype(np.float32)
+
+    rng = np.random.default_rng(6)
+    B, k = 8, 12
+    x = np.clip(rng.standard_normal((B, n)).astype(np.float32), lb, ub)
+    z = rng.standard_normal((B, m)).astype(np.float32)
+    xa = np.clip(rng.standard_normal((B, n)).astype(np.float32), lb, ub)
+    za = rng.standard_normal((B, m)).astype(np.float32)
+    xs = rng.standard_normal((B, n)).astype(np.float32)  # mid-epoch sums
+    zs = rng.standard_normal((B, m)).astype(np.float32)
+    c = 0.1 * rng.standard_normal((B, n)).astype(np.float32)
+    b = 0.1 * rng.standard_normal((B, m)).astype(np.float32)
+    tau = (0.4 / _power_norm(Ah) * np.ones((B, 1))).astype(np.float32)
+    sig = tau.copy()
+    k0 = rng.integers(0, 200, (B, 1)).astype(np.float32)  # per-lane
+
+    args = (x, z, xa, za, xs, zs, c, b, tau, sig, k0)
+    sweep_p = _pallas_halpern_sweep_fn(
+        jnp.asarray(Ah), jnp.asarray(Ah.T), lb, ub, eq, k,
+        lanes_per_block=4, interpret=True)
+    out_p = sweep_p(*map(jnp.asarray, args))
+
+    def sweep_x(x, z, xa, za, xs, zs, c, b, tau, sig, k0):
+        def body(carry, i):
+            x, z, _, _, xs, zs = carry
+            xt = jnp.clip(x - tau * (c + z @ jnp.asarray(Ah)),
+                          lb[None, :], ub[None, :])
+            z_t = z + sig * (((2 * xt - x) @ jnp.asarray(Ah.T)) - b)
+            zt = jnp.where(eq[None, :] > 0.5, z_t, jnp.clip(z_t, 0.0, None))
+            j = k0 + i.astype(jnp.float32)
+            w = (j + 1.0) / (j + 2.0)
+            xn = w * (2 * xt - x) + (1 - w) * xa
+            zn = w * (2 * zt - z) + (1 - w) * za
+            return (xn, zn, xt, zt, xs + xt, zs + zt), None
+
+        (x, z, xt, zt, xs, zs), _ = jax.lax.scan(
+            body, (x, z, x, z, xs, zs), jnp.arange(k, dtype=jnp.int32))
+        return x, z, xt, zt, xs, zs
+
+    out_x = sweep_x(*map(jnp.asarray, args))
+    for got, want in zip(out_p, out_x):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
 def test_batch_axis_validation(nlp):
     defaults = nlp.default_params()
     solver = make_pdlp_batch_solver(
