@@ -236,6 +236,14 @@ ROOFLINE_KEYS = ("device", "peak_flops", "hbm_gbps", "flops_per_solve",
 #: sub-dict per algorithm in solvers.pdlp.PDLP_ALGORITHMS, same batch)
 PDLP_VARIANT_KEYS = ("pdhg_iters_mean", "solves_per_sec",
                      "obj_rel_err_vs_highs")
+#: per-tier sub-keys of the ``pdlp_precision`` A/B section (f32 vs
+#: bf16-inner + high-tier iterative refinement, same batch-366
+#: workload; ``peak_bytes`` is None unless DISPATCHES_TPU_OBS_PROFILE
+#: provides a cost card)
+PDLP_PRECISION_KEYS = ("pdhg_iters_mean", "solves_per_sec",
+                       "obj_rel_err_vs_highs", "refine_rounds_mean",
+                       "peak_bytes")
+PDLP_PRECISION_TIERS = ("f32", "bf16x-f32")
 
 
 def validate_bench_output(out):
@@ -260,6 +268,20 @@ def validate_bench_output(out):
                 raise ValueError(
                     f"bench pdlp_variant[{algo!r}] missing sub-keys: "
                     f"{missing}")
+    precision = out.get("pdlp_precision")
+    if precision is not None:
+        for tier in PDLP_PRECISION_TIERS:
+            sub = precision.get(tier)
+            if sub is None:
+                raise ValueError(f"bench pdlp_precision missing '{tier}'")
+            missing = [k for k in PDLP_PRECISION_KEYS if k not in sub]
+            if missing:
+                raise ValueError(
+                    f"bench pdlp_precision[{tier!r}] missing sub-keys: "
+                    f"{missing}")
+        if "sps_ratio_bf16_vs_f32" not in precision:
+            raise ValueError(
+                "bench pdlp_precision missing 'sps_ratio_bf16_vs_f32'")
     return out
 
 
@@ -287,12 +309,17 @@ def _finalize_output(out):
         # guardrail for the reflected-Halpern solver upgrade
         if out.get("pdhg_iters_mean") is not None:
             metrics["pdhg_iters_mean"] = out["pdhg_iters_mean"]
+        # post-refinement accuracy is gated too (lower is better): the
+        # guardrail that catches a precision/refinement regression
+        if out.get("obj_rel_err_vs_highs") is not None:
+            metrics["obj_rel_err"] = out["obj_rel_err_vs_highs"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
             extra={"solver_path": out.get("solver_path"),
                    "mfu": out.get("mfu"),
-                   "algorithm": out.get("pdlp_algorithm")}))
+                   "algorithm": out.get("pdlp_algorithm"),
+                   "precision": out.get("pdlp_precision_resolved")}))
     except Exception as exc:
         print(f"bench ledger warning: {exc}", file=sys.stderr)
 
@@ -333,9 +360,13 @@ def run_bench():
     # software-emulated on TPU and ~90x slower; see pdlp.py).  The
     # algorithm (reflected-Halpern by default, avg via options or
     # DISPATCHES_TPU_PDLP_ALGO) is tagged in the output + ledger.
-    from dispatches_tpu.solvers.pdlp import resolve_pdlp_algorithm
+    from dispatches_tpu.solvers.pdlp import (
+        resolve_pdlp_algorithm,
+        resolve_pdlp_precision,
+    )
 
     pdlp_algorithm = resolve_pdlp_algorithm(None)
+    pdlp_precision = resolve_pdlp_precision(None)
     solver = make_pdlp_solver(nlp, PDLPOptions(tol=1e-5, dtype="float32"))
 
     params = nlp.default_params()
@@ -374,8 +405,8 @@ def run_bench():
     # fine).  Try (solver path, chunk) pairs: full batch first, then
     # fixed-shape chunked dispatch; pallas-batch before vmapped.
     def make_sweep(chunk, fn):
-        stats = {"iters": []}  # mean PDHG iters per dispatched chunk,
-        # recorded for the MFU/roofline readout
+        stats = {"iters": [], "refined": []}  # mean PDHG iters (for the
+        # MFU/roofline readout) and refinement epochs per chunk
 
         def sweep(lmps_, cfs_):
             objs = []
@@ -387,6 +418,9 @@ def run_bench():
                     cc = np.concatenate([cc, np.repeat(cc[-1:], pad, 0)])
                 r = fn(batched_params(lc, cc))
                 stats["iters"].append(float(np.mean(np.asarray(r.iters))))
+                rf = getattr(r, "refined", None)
+                if rf is not None:
+                    stats["refined"].append(float(np.mean(np.asarray(rf))))
                 objs.append(np.asarray(r.obj))
             return np.concatenate(objs)[: len(lmps_)]
 
@@ -431,6 +465,7 @@ def run_bench():
     out = {
         "backend": backend,
         "pdlp_algorithm": pdlp_algorithm,
+        "pdlp_precision_resolved": pdlp_precision,
         "solver_path": solver_path,
         "baseline": "serial scipy-HiGHS per scenario (IPOPT-class), "
                     "independent reference-formulation assembly",
@@ -516,6 +551,57 @@ def run_bench():
         out["pdlp_variant"] = variants
     except Exception as exc:  # telemetry must never kill the headline
         out["pdlp_variant_error"] = str(exc)[:120]
+
+    # ---- pdlp precision A/B: full-f32 vs bf16-inner iterations + the
+    # high-tier iterative-refinement tail, same batch-366 workload
+    # (ISSUE 7).  The accuracy column is the acceptance gate
+    # (obj_rel_err <= 1e-4 post-refinement); the throughput ratio is
+    # the roofline payoff — on the MXU a bf16 matmul pass costs 1/3 of
+    # an f32-HIGHEST product, on CPU the win is the earlier low-tier
+    # loop exit.  peak_bytes rides along when OBS_PROFILE has a cost
+    # card for the tier's program -------------------------------------
+    try:
+        def _tier_peak_bytes(label):
+            try:
+                from dispatches_tpu.obs import profile
+
+                if not profile.enabled():
+                    return None
+                cards = profile.cards_for(label)
+                return max(c["peak_bytes"] for c in cards) if cards else None
+            except Exception:
+                return None
+
+        from dispatches_tpu.analysis.runtime import graft_jit
+
+        tiers = {}
+        for prec_ in PDLP_PRECISION_TIERS:
+            pfn = graft_jit(jax.vmap(make_pdlp_solver(
+                nlp, PDLPOptions(tol=1e-5, dtype="float32",
+                                 precision=prec_)), in_axes=in_axes),
+                label=f"bench.precision.{prec_}")
+            sw_p = make_sweep(N_SCENARIOS, pfn)
+            objs_p = sw_p(lmps, cfs)  # compile + solve
+            t0 = time.perf_counter()
+            sw_p(lmps, cfs)
+            per_p = time.perf_counter() - t0
+            err_p = float(np.max(np.abs(objs_p[:n_serial] - ref_objs)
+                                 / np.maximum(np.abs(ref_objs), 1.0)))
+            tiers[prec_] = {
+                "pdhg_iters_mean": round(
+                    float(np.mean(sw_p.stats["iters"])), 1),
+                "solves_per_sec": round(N_SCENARIOS / per_p, 2),
+                "obj_rel_err_vs_highs": round(err_p, 8),
+                "refine_rounds_mean": round(
+                    float(np.mean(sw_p.stats["refined"] or [0.0])), 2),
+                "peak_bytes": _tier_peak_bytes(f"bench.precision.{prec_}"),
+            }
+        tiers["sps_ratio_bf16_vs_f32"] = round(
+            tiers["bf16x-f32"]["solves_per_sec"]
+            / max(tiers["f32"]["solves_per_sec"], 1e-9), 4)
+        out["pdlp_precision"] = tiers
+    except Exception as exc:  # telemetry must never kill the headline
+        out["pdlp_precision_error"] = str(exc)[:120]
 
     # ---- serve-layer overhead: N staggered single requests through
     # the micro-batching SolveService vs the same N solved as one

@@ -57,6 +57,14 @@ REGISTERED_FLAGS = {
     "PDLP_ALGO": "override PDLPOptions.algorithm ('avg' | 'halpern') "
     "for every PDLP consumer (solvers.pdlp.resolve_pdlp_algorithm; "
     "read at solver-build time)",
+    "PDLP_PRECISION": "override PDLPOptions.precision / "
+    "IPMOptions.precision ('f32' | 'bf16x-f32' | 'f32-f64') for every "
+    "solver consumer (solvers.pdlp.resolve_pdlp_precision; read at "
+    "solver-build time — serve folds the resolved value into its "
+    "bucket fingerprint)",
+    "PDLP_REFINE_ROUNDS": "override PDLPOptions.refine_rounds, the max "
+    "high-tier iterative-refinement epochs appended to a low-precision "
+    "PDLP solve (solvers.pdlp.resolve_pdlp_refine_rounds)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -151,6 +151,8 @@ CORPUS: Dict[str, Dict[str, str]] = {
             prof = os.environ.get("DISPATCHES_TPU_OBS_PROFILE")
             led_dir = os.environ.get("DISPATCHES_TPU_OBS_LEDGER_DIR")
             algo = os.environ.get("DISPATCHES_TPU_PDLP_ALGO")
+            prec = os.environ.get("DISPATCHES_TPU_PDLP_PRECISION")
+            rounds = os.environ.get("DISPATCHES_TPU_PDLP_REFINE_ROUNDS")
         """,
     },
 }

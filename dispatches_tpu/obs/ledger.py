@@ -75,6 +75,14 @@ GATED_METRICS = {
     "compile_count": -1,
     "peak_bytes": -1,
     "pdhg_iters_mean": -1,
+    # post-refinement accuracy vs the HiGHS reference: the mixed-
+    # precision work trades matmul precision for speed, and this is the
+    # metric that catches the trade going wrong (a precision/refinement
+    # regression shows up here before any test tolerance trips).
+    # Deterministic per (workload, backend) — same compiled program,
+    # same bytes — so the relative gate is not noisy despite the small
+    # magnitudes.
+    "obj_rel_err": -1,
 }
 
 _GIT_SHA: Optional[str] = None

@@ -64,7 +64,11 @@ from dispatches_tpu.serve.metrics import (
     format_stats,
 )
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
-from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
+from dispatches_tpu.solvers.pdlp import (
+    PDLPOptions,
+    make_pdlp_solver,
+    resolve_pdlp_precision,
+)
 
 __all__ = [
     "RequestStatus",
@@ -103,6 +107,14 @@ class ServeOptions:
     #: Lane counts map deterministically to one sharding each, so the
     #: one-program-per-(bucket, lane-count) accounting is unchanged.
     mesh: Optional[object] = None
+    #: service-level default precision tier for the buckets this service
+    #: builds (same vocabulary as ``PDLPOptions.precision`` /
+    #: ``IPMOptions.precision``: "f32" | "bf16x-f32" | "f32-f64").
+    #: Request-level ``options={"precision": ...}`` wins over this, and
+    #: the ``DISPATCHES_TPU_PDLP_PRECISION`` env override wins over
+    #: both.  The RESOLVED tier is folded into the bucket fingerprint,
+    #: so bf16 and f32 requests never share a compiled program.
+    pdlp_precision: Optional[str] = None
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeOptions":
@@ -209,6 +221,9 @@ class _Bucket:
         self.pending: "deque[SolveHandle]" = deque()
         kind = solver.lower()
         opts = dict(options or {})
+        # resolved at bucket-build time, like the kernels themselves
+        # (env override included) — telemetry for tests/stats
+        self.precision = resolve_pdlp_precision(opts.get("precision"))
         base = opts.pop("base_solver", None)
         if base is not None:
             # caller-built per-scenario solver (e.g. the bidder's
@@ -288,9 +303,20 @@ class SolveService:
 
     def _bucket_for(self, nlp, solver: str, options: Dict, params,
                     base_solver) -> _Bucket:
-        opts_key = freeze_options(
-            {k: v for k, v in (options or {}).items()})
-        key = (id(nlp), solver.lower(), opts_key, params_signature(params),
+        opts = dict(options or {})
+        if self.options.pdlp_precision is not None:
+            opts.setdefault("precision", self.options.pdlp_precision)
+        # fold the RESOLVED precision tier into the bucket key: the env
+        # override is read at bucket-build time, so two requests that
+        # resolve to different tiers (bf16 vs f32 inner iterations) must
+        # never share a compiled program — and two spellings of the same
+        # tier (explicit option vs env vs default) must share one, hence
+        # the normalisation before freezing
+        prec = resolve_pdlp_precision(opts.pop("precision", None))
+        opts["precision"] = prec
+        opts_key = freeze_options(opts)
+        key = (id(nlp), solver.lower(), opts_key, prec,
+               params_signature(params),
                id(base_solver) if base_solver is not None else None)
         bucket = self._buckets.get(key)
         # id() keys can collide after GC reuses an address (the factory
@@ -300,7 +326,6 @@ class SolveService:
             bucket = None
         if bucket is None:
             label = f"{solver.lower()}#{len(self._buckets)}"
-            opts = dict(options or {})
             if base_solver is not None:
                 opts["base_solver"] = base_solver
             bucket = _Bucket(nlp, solver, opts, label)
@@ -342,8 +367,14 @@ class SolveService:
                     self._warm_misses += 1
                 else:
                     self._warm_hits += 1
+            # cast to the bucket's x0 dtype on ingest: a warm start
+            # carried over from a different-precision solve (or a
+            # caller-supplied f32 vector) must not retrace the bucket's
+            # compiled signature or poison the lanes it shares a stack
+            # with
             handle.x0 = np.asarray(
-                bucket.default_x0 if x0 is None else x0)
+                bucket.default_x0 if x0 is None else x0,
+                dtype=bucket.default_x0.dtype)
         bucket.pending.append(handle)
         bucket.stats.record_submitted()
         self._submitted += 1

@@ -11,10 +11,13 @@ from dispatches_tpu.solvers.pdlp_batch import (
     make_pdlp_batch_solver,
 )
 from dispatches_tpu.solvers.pdlp import (
+    PDLP_PRECISIONS,
     LPResult,
     PDLPOptions,
     make_lp_data,
     make_pdlp_solver,
+    resolve_pdlp_precision,
+    resolve_pdlp_refine_rounds,
 )
 from dispatches_tpu.solvers.factory import SolverFactory
 
@@ -24,9 +27,12 @@ __all__ = [
     "make_ipm_solver",
     "solve_nlp",
     "LPResult",
+    "PDLP_PRECISIONS",
     "PDLPOptions",
     "make_lp_data",
     "make_pdlp_solver",
+    "resolve_pdlp_precision",
+    "resolve_pdlp_refine_rounds",
     "BatchPDLPOptions",
     "make_pdlp_batch_solver",
     "SolverFactory",

@@ -22,6 +22,7 @@ from dispatches_tpu.solvers.pdlp import (
     PDLPOptions,
     make_pdlp_solver,
     resolve_pdlp_algorithm,
+    resolve_pdlp_precision,
 )
 
 
@@ -73,16 +74,24 @@ class _IPMSolver:
         opts.update(opt_overrides)
         params = nlp.default_params() if params is None else params
         key = tuple(sorted(opts.items()))
-        solver = self._cache.get(nlp, key)
-        if solver is None:
+        entry = self._cache.get(nlp, key)
+        if entry is None:
             ipm_opts = IPMOptions(**opts) if opts else IPMOptions()
-            solver = graft_jit(make_ipm_solver(nlp, ipm_opts),
-                               label="factory.ipm")
-            self._cache.set(nlp, key, solver)
+            # resolve once, at build time (env override included), so
+            # tee reports the precision the cached solver was built with
+            prec = resolve_pdlp_precision(ipm_opts.precision)
+            entry = (
+                graft_jit(make_ipm_solver(nlp, ipm_opts),
+                          label="factory.ipm"),
+                prec,
+            )
+            self._cache.set(nlp, key, entry)
+        solver, prec = entry
         res = solver(params) if x0 is None else solver(params, x0)
         if tee:
             print(
-                f"[dispatches_tpu.ipm] iters={int(res.iterations)} "
+                f"[dispatches_tpu.ipm] precision={prec} "
+                f"iters={int(res.iterations)} "
                 f"kkt_error={float(res.kkt_error):.3e} converged={bool(res.converged)} "
                 f"status={int(res.status)} obj={float(res.obj):.8g}"
             )
@@ -125,13 +134,15 @@ class _PDLPSolver:
             lp_kw.setdefault("dtype", "float64")
             try:
                 # resolve once, at build time (env override included),
-                # so tee reports the algorithm the cached solver runs
+                # so tee reports the algorithm/precision the cached
+                # solver actually runs
                 algo = resolve_pdlp_algorithm(lp_kw.get("algorithm"))
+                prec = resolve_pdlp_precision(lp_kw.get("precision"))
                 kind_solver = (
                     "pdlp",
                     graft_jit(make_pdlp_solver(nlp, PDLPOptions(**lp_kw)),
                               label="factory.pdlp"),
-                    algo,
+                    (algo, prec),
                 )
             except ValueError:  # not affine: hand off to the NLP kernel
                 if tee:
@@ -150,7 +161,7 @@ class _PDLPSolver:
                     None,
                 )
             self._cache.set(nlp, key, kind_solver)
-        kind, solver, algo = kind_solver
+        kind, solver, meta = kind_solver
         if kind == "ipm":
             res = solver(params) if x0 is None else solver(params, x0)
             if tee:
@@ -165,8 +176,11 @@ class _PDLPSolver:
             print("[dispatches_tpu.pdlp] x0 ignored (PDHG cold start)")
         res = solver(params)
         if tee:
+            algo, prec = meta
             print(
-                f"[dispatches_tpu.pdlp] algo={algo} iters={int(res.iters)} "
+                f"[dispatches_tpu.pdlp] algo={algo} precision={prec} "
+                f"iters={int(res.iters)} "
+                f"refined={int(res.refined)} "
                 f"pr={float(res.pr_err):.3e} du={float(res.du_err):.3e} "
                 f"gap={float(res.gap):.3e} converged={bool(res.converged)} "
                 f"obj={float(res.obj):.8g}"

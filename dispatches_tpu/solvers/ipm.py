@@ -47,6 +47,7 @@ import numpy as np
 from jax import lax
 
 from dispatches_tpu.analysis.runtime import nan_guard
+from dispatches_tpu.solvers.pdlp import resolve_pdlp_precision
 
 
 class IPMOptions(NamedTuple):
@@ -79,6 +80,16 @@ class IPMOptions(NamedTuple):
     # exit after this many iterations without improving the best mu=0
     # KKT error (0 disables); the best iterate is what gets reported
     noimp_exit: int = 60
+    # Matmul-precision policy for the KKT condensation products (same
+    # vocabulary as PDLPOptions.precision; resolved through
+    # resolve_pdlp_precision so DISPATCHES_TPU_PDLP_PRECISION overrides
+    # both solvers).  Factorizations, residuals, and termination always
+    # run in the iterate dtype; Newton itself is the iterative
+    # refinement — every iteration re-solves from an exact
+    # high-precision KKT residual, so a low-tier direction only costs
+    # extra iterations, never final accuracy.  None = "f32" (backend
+    # default matmuls — bit-identical to pre-precision builds).
+    precision: Optional[str] = None
 
 
 class IPMResult(NamedTuple):
@@ -166,6 +177,14 @@ def make_ipm_solver(
     state) — the solver-iteration telemetry the reference gets from
     idaeslog/solver_log tee output (SURVEY.md §5)."""
     opts = options or IPMOptions()
+    # condensation-matmul precision tier (see IPMOptions.precision);
+    # "f32" maps to None so the default policy leaves the jaxpr
+    # untouched relative to pre-precision builds
+    _kkt_prec = {
+        "f32": None,
+        "bf16x-f32": jax.lax.Precision.DEFAULT,
+        "f32-f64": jax.lax.Precision.HIGHEST,
+    }[resolve_pdlp_precision(getattr(opts, "precision", None))]
     n_x, m_eq, m_in = nlp.n, nlp.m_eq, nlp.m_ineq
     n = n_x + m_in
     m = m_eq + m_in
@@ -374,13 +393,21 @@ def make_ipm_solver(
         _, L_H, _ = lax.while_loop(esc_cond, esc_body, carry)
 
         if m:
-            # S = J H^-1 J^T + delta_c I  via  X = H^-1 J^T
+            # S = J H^-1 J^T + delta_c I  via  X = H^-1 J^T; the dense
+            # J-products are the MXU-bound part and honor the precision
+            # tier — the Cholesky/triangular solves stay in W.dtype
             X = cho_solve((L_H, True), J.T)
-            S = J @ X + opts.delta_c * jnp.eye(m, dtype=W.dtype)
+            S = jnp.matmul(J, X, precision=_kkt_prec) \
+                + opts.delta_c * jnp.eye(m, dtype=W.dtype)
             L_S = jnp.linalg.cholesky(S)
             t = cho_solve((L_H, True), r1)
-            dlam = cho_solve((L_S, True), c - J @ t)
-            dy = -cho_solve((L_H, True), r1 + J.T @ dlam)
+            dlam = cho_solve(
+                (L_S, True), c - jnp.matmul(J, t, precision=_kkt_prec)
+            )
+            dy = -cho_solve(
+                (L_H, True),
+                r1 + jnp.matmul(J.T, dlam, precision=_kkt_prec),
+            )
         else:
             dlam = jnp.zeros((0,), dtype=W.dtype)
             dy = -cho_solve((L_H, True), r1)

@@ -41,6 +41,7 @@ from dispatches_tpu.solvers.pdlp import (
     LPResult,
     PDLPOptions,
     _power_norm,
+    _precision_plan,
     _scalings,
     make_lp_data,
 )
@@ -53,8 +54,30 @@ class BatchPDLPOptions(PDLPOptions):
     interpret: bool = False      # pallas interpreter (CPU tests)
 
 
+def _pallas_dot(dtype, low_precision):
+    """The sweep kernels' matmul for one precision tier.
+
+    High tier requests full-``dtype`` MXU passes (HIGHEST); the low
+    tier instead casts BOTH operands to bfloat16 and accumulates in
+    ``dtype`` via ``preferred_element_type`` — one native MXU input
+    pass where HIGHEST costs ~3, and the explicit casts make interpret
+    mode (CPU tests) truncate exactly like real hardware and the XLA
+    fallback."""
+    base = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=dtype,
+    )
+    if not low_precision:
+        return functools.partial(base, precision=jax.lax.Precision.HIGHEST)
+
+    def dot(u, M):
+        return base(u.astype(jnp.bfloat16), M)
+    return dot
+
+
 def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
-                     interpret):
+                     interpret, low_precision=False):
     """Build ``sweep(x, z, xs, zs, c, b, tau, sig) -> (x, z, xs, zs)``
     running ``k`` PDHG steps fused in one Pallas kernel.
 
@@ -62,7 +85,8 @@ def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
     ``AhT`` (n, m) are broadcast to every program, so the dual->primal
     product is ``z @ Ah`` and the primal->dual one ``v @ AhT`` — both
     row-major MXU matmuls.  Static data (bounds, equality mask) is
-    baked into the kernel as constants."""
+    baked into the kernel as constants.  ``low_precision=True`` runs
+    the matmuls on bfloat16 inputs (see :func:`_pallas_dot`)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -78,6 +102,9 @@ def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
                x_out, z_out, xs_out, zs_out):
         A = Ah_ref[:]
         AT = AhT_ref[:]
+        if low_precision:
+            A = A.astype(jnp.bfloat16)
+            AT = AT.astype(jnp.bfloat16)
         lb_r = lb_ref[:]
         ub_r = ub_ref[:]
         eq_r = eq_ref[:]
@@ -86,15 +113,12 @@ def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
         tau = tau_ref[:]
         sig = sig_ref[:]
 
-        # full-f32 MXU passes: default precision runs bf16 input passes,
-        # which floor the PDHG fixed point at ~1e-3 relative error
-        # (measured on the XLA path, pdlp.py:143-147) — far above tol
-        dot = functools.partial(
-            jax.lax.dot_general,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=dtype,
-        )
+        # high tier: full-f32 MXU passes — default precision runs bf16
+        # input passes, which floor the PDHG fixed point at ~1e-3
+        # relative error (measured on the XLA path; see pdlp.py).  The
+        # low tier embraces exactly that floor and leaves accuracy to
+        # the refinement tail outside the kernel.
+        dot = _pallas_dot(dtype, low_precision)
 
         def body(_, carry):
             x, z, xs, zs = carry
@@ -169,7 +193,7 @@ def _pallas_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
 
 
 def _pallas_halpern_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
-                             interpret):
+                             interpret, low_precision=False):
     """Build ``sweep(x, z, xa, za, xs, zs, c, b, tau, sig, k0) ->
     (x, z, xt, zt, xs, zs)`` running ``k`` reflected-Halpern PDHG steps
     fused in one Pallas kernel (same layout as :func:`_pallas_sweep_fn`).
@@ -198,6 +222,9 @@ def _pallas_halpern_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
                x_out, z_out, xt_out, zt_out, xs_out, zs_out):
         A = Ah_ref[:]
         AT = AhT_ref[:]
+        if low_precision:
+            A = A.astype(jnp.bfloat16)
+            AT = AT.astype(jnp.bfloat16)
         lb_r = lb_ref[:]
         ub_r = ub_ref[:]
         eq_r = eq_ref[:]
@@ -209,13 +236,8 @@ def _pallas_halpern_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
         xa = xa_ref[:]
         za = za_ref[:]
 
-        # full-f32 MXU passes — same rationale as _pallas_sweep_fn
-        dot = functools.partial(
-            jax.lax.dot_general,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=dtype,
-        )
+        # tier-selected matmul — same rationale as _pallas_sweep_fn
+        dot = _pallas_dot(dtype, low_precision)
 
         def body(i, carry):
             x, z, _, _, xs, zs = carry
@@ -319,6 +341,8 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             "its converged ~1e-5 KKT error without it"
         )
     dtype = jnp.dtype(opt.dtype)
+    plan = _precision_plan(opt)
+    low_prec = plan.policy == "bf16x-f32"
     data = lp_data if lp_data is not None else make_lp_data(nlp)
     K, G = data["K"], data["G"]
     m_eq, m_in = K.shape[0], G.shape[0]
@@ -340,24 +364,41 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
     inv_step = jnp.asarray(1.0 / norm_A, dtype)
     _prec = jax.lax.Precision.HIGHEST
 
+    # low-tier operands for the XLA-fallback sweeps: bf16 matmul inputs
+    # with dtype accumulation, mirroring _pallas_dot (the KKT checks
+    # and refinement tail below always use the high-tier Ah_j/AhT_j)
+    if low_prec:
+        Ah_sw = Ah_j.astype(jnp.bfloat16)
+        AhT_sw = AhT_j.astype(jnp.bfloat16)
+
+        def _mm(u, M):
+            return jnp.matmul(u.astype(jnp.bfloat16), M,
+                              preferred_element_type=dtype)
+    else:
+        Ah_sw, AhT_sw = Ah_j, AhT_j
+
+        def _mm(u, M):
+            return jnp.matmul(u, M, precision=_prec)
+
     use_pallas = opt.sweep == "pallas" or (
         opt.sweep == "auto" and jax.devices()[0].platform == "tpu"
     )
     if use_pallas and algo == "halpern":
         sweep = _pallas_halpern_sweep_fn(Ah_j, AhT_j, lb_h, ub_h, is_eq_f,
                                          opt.check_every,
-                                         opt.lanes_per_block, opt.interpret)
+                                         opt.lanes_per_block, opt.interpret,
+                                         low_precision=low_prec)
     elif use_pallas:
         sweep = _pallas_sweep_fn(Ah_j, AhT_j, lb_h, ub_h, is_eq_f,
                                  opt.check_every, opt.lanes_per_block,
-                                 opt.interpret)
+                                 opt.interpret, low_precision=low_prec)
     elif algo == "halpern":
         def sweep(x, z, xa, za, xs, zs, c, b, tau, sig, k0):
             def body(carry, i):
                 x, z, _, _, xs, zs = carry
-                grad = c + jnp.matmul(z, Ah_j, precision=_prec)
+                grad = c + _mm(z, Ah_sw)
                 xt = jnp.clip(x - tau * grad, lb_h[None, :], ub_h[None, :])
-                ax = jnp.matmul(2.0 * xt - x, AhT_j, precision=_prec)
+                ax = _mm(2.0 * xt - x, AhT_sw)
                 z_t = z + sig * (ax - b)
                 zt = jnp.where(is_eq[None, :], z_t, jnp.clip(z_t, 0.0, None))
                 j = k0 + i.astype(dtype)      # (B, 1) per-lane step count
@@ -375,9 +416,9 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
         def sweep(x, z, xs, zs, c, b, tau, sig):
             def body(carry, _):
                 x, z, xs, zs = carry
-                grad = c + jnp.matmul(z, Ah_j, precision=_prec)
+                grad = c + _mm(z, Ah_sw)
                 xn = jnp.clip(x - tau * grad, lb_h[None, :], ub_h[None, :])
-                ax = jnp.matmul(2.0 * xn - x, AhT_j, precision=_prec)
+                ax = _mm(2.0 * xn - x, AhT_sw)
                 zt = z + sig * (ax - b)
                 zn = jnp.where(is_eq[None, :], zt, jnp.clip(zt, 0.0, None))
                 return (xn, zn, xs + xn, zs + zn), None
@@ -399,32 +440,145 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
         return jnp.max(jnp.abs(v), axis=-1) if v.shape[-1] else jnp.zeros(
             v.shape[0], dtype)
 
-    def _kkt_errors(x, z, c, b):
-        """Per-lane relative primal/dual/gap errors (batched transcription
-        of pdlp.py:_kkt_errors)."""
-        ax = jnp.matmul(x, AhT_j, precision=_prec)
-        viol = jnp.where(is_eq[None, :], jnp.abs(ax - b),
-                         jnp.maximum(ax - b, 0.0))
-        pr = _inf_rows(viol) / (1.0 + _inf_rows(b))
-        r = c + jnp.matmul(z, Ah_j, precision=_prec)
-        rd = r - jnp.where(
-            r > 0,
-            jnp.where(jnp.isfinite(lb_h)[None, :], r, 0.0),
-            jnp.where(jnp.isfinite(ub_h)[None, :], r, 0.0),
-        )
-        du = _inf_rows(rd) / (1.0 + _inf_rows(c))
-        pobj = jnp.sum(c * x, axis=-1)
-        lb_fin = jnp.where(jnp.isfinite(lb_h), lb_h, 0.0)
-        ub_fin = jnp.where(jnp.isfinite(ub_h), ub_h, 0.0)
-        dobj = -jnp.sum(b * z, axis=-1) + jnp.sum(
-            jnp.clip(r, 0.0, None) * lb_fin[None, :]
-            + jnp.clip(r, None, 0.0) * ub_fin[None, :], axis=-1)
-        gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
-        return pr, du, gap
+    def _make_kkt(Ah_, AhT_, lb_, ub_):
+        """Per-lane KKT-error evaluator for one precision tier (batched
+        transcription of pdlp.py:_make_kkt)."""
+        zdt = lb_.dtype
+
+        def _inf_rows_(v):
+            return (jnp.max(jnp.abs(v), axis=-1) if v.shape[-1]
+                    else jnp.zeros(v.shape[0], zdt))
+
+        def kkt(x, z, c, b):
+            ax = jnp.matmul(x, AhT_, precision=_prec)
+            viol = jnp.where(is_eq[None, :], jnp.abs(ax - b),
+                             jnp.maximum(ax - b, 0.0))
+            pr = _inf_rows_(viol) / (1.0 + _inf_rows_(b))
+            r = c + jnp.matmul(z, Ah_, precision=_prec)
+            rd = r - jnp.where(
+                r > 0,
+                jnp.where(jnp.isfinite(lb_)[None, :], r, 0.0),
+                jnp.where(jnp.isfinite(ub_)[None, :], r, 0.0),
+            )
+            du = _inf_rows_(rd) / (1.0 + _inf_rows_(c))
+            pobj = jnp.sum(c * x, axis=-1)
+            lb_fin = jnp.where(jnp.isfinite(lb_), lb_, 0.0)
+            ub_fin = jnp.where(jnp.isfinite(ub_), ub_, 0.0)
+            dobj = -jnp.sum(b * z, axis=-1) + jnp.sum(
+                jnp.clip(r, 0.0, None) * lb_fin[None, :]
+                + jnp.clip(r, None, 0.0) * ub_fin[None, :], axis=-1)
+            gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj)
+                                          + jnp.abs(dobj))
+            return pr, du, gap
+        return kkt
+
+    _kkt_errors = _make_kkt(Ah_j, AhT_j, lb_h, ub_h)
 
     def _err(x, z, c, b):
         pr, du, gap = _kkt_errors(x, z, c, b)
         return jnp.maximum(jnp.maximum(pr, du), gap)
+
+    # the low-tier main loop stops at the tier's KKT floor and hands
+    # off to the refinement tail; without a tail, both are just tol
+    tol_main = plan.inner_tol
+    stall_min = (opt.stall_min_iters if plan.rounds == 0
+                 else min(opt.stall_min_iters, 12 * opt.check_every))
+
+    if plan.rounds:
+        hdt = jnp.dtype(plan.hi)
+        Ah_hi = jnp.asarray(Ah, hdt)
+        AhT_hi = jnp.asarray(Ah.T, hdt)
+        lb_hi = jnp.asarray(data["lb"] / dc, hdt)
+        ub_hi = jnp.asarray(data["ub"] / dc, hdt)
+        kkt_hi = _make_kkt(Ah_hi, AhT_hi, lb_hi, ub_hi)
+
+        def _refine(x0_, z0_, c, b, omega):
+            """Per-lane iterative-refinement tail: up to ``plan.rounds``
+            epochs of ``opt.refine_iters`` reflected-Halpern steps in
+            the HIGH tier (always the XLA path — the tail is a small
+            fraction of total work), each epoch re-anchored at its own
+            start.  The epoch loop stops once every lane is at ``tol``
+            (already-converged lanes freeze while stragglers finish);
+            ``rounds`` counts only the epochs a lane actually
+            consumed, so a batch that converges low-tier pays
+            nothing."""
+            x_it = x0_.astype(hdt)
+            z_it = z0_.astype(hdt)
+            ch = c.astype(hdt)
+            bh = b.astype(hdt)
+            tau = (omega * inv_step * _HALPERN_STEP_SCALE).astype(
+                hdt)[:, None]
+            sig = (inv_step / omega * _HALPERN_STEP_SCALE).astype(
+                hdt)[:, None]
+
+            def err_of(x_, z_):
+                pr, du, gap = kkt_hi(x_, z_, ch, bh)
+                return jnp.maximum(jnp.maximum(pr, du), gap), (pr, du, gap)
+
+            e_b, (pr, du, gap) = err_of(x_it, z_it)
+
+            def r_cond(carry):
+                return jnp.any(jnp.logical_and(carry[4] > opt.tol,
+                                               carry[8] < plan.rounds))
+
+            def r_body(carry):
+                x_it, z_it, xb, zb, e_b, pr, du, gap, rounds = carry
+                need = jnp.logical_and(e_b > opt.tol,
+                                       rounds < plan.rounds)
+
+                def body(c2, j):
+                    x_, z_, _, _, xs, zs = c2
+                    grad = ch + jnp.matmul(z_, Ah_hi, precision=_prec)
+                    xt = jnp.clip(x_ - tau * grad, lb_hi[None, :],
+                                  ub_hi[None, :])
+                    ax = jnp.matmul(2.0 * xt - x_, AhT_hi, precision=_prec)
+                    z_t = z_ + sig * (ax - bh)
+                    zt = jnp.where(is_eq[None, :], z_t,
+                                   jnp.clip(z_t, 0.0, None))
+                    # all lanes re-anchor at the epoch start, so the
+                    # Halpern weight is a scalar per step here
+                    w = ((j + 1.0) / (j + 2.0)).astype(hdt)
+                    xn = w * (2.0 * xt - x_) + (1.0 - w) * x_it
+                    zn = w * (2.0 * zt - z_) + (1.0 - w) * z_it
+                    return (xn, zn, xt, zt, xs + xt, zs + zt), None
+
+                steps = jnp.arange(opt.refine_iters, dtype=jnp.int32)
+                (x1, z1, xt, zt, xs, zs), _ = jax.lax.scan(
+                    body,
+                    (x_it, z_it, x_it, z_it,
+                     jnp.zeros_like(x_it), jnp.zeros_like(z_it)),
+                    steps)
+                e_cur, k_cur = err_of(xt, zt)
+                xa = xs / opt.refine_iters
+                za = zs / opt.refine_iters
+                e_avg, k_avg = err_of(xa, za)
+                use_avg = (e_avg < e_cur)[:, None]
+                xc = jnp.where(use_avg, xa, xt)
+                zc = jnp.where(use_avg, za, zt)
+                e_c = jnp.minimum(e_avg, e_cur)
+                new_best = jnp.logical_and(need, e_c < e_b)
+                nb_col = new_best[:, None]
+                xb = jnp.where(nb_col, xc, xb)
+                zb = jnp.where(nb_col, zc, zb)
+                pick = jnp.where(use_avg[:, 0], k_avg[0], k_cur[0])
+                pr = jnp.where(new_best, pick, pr)
+                pick = jnp.where(use_avg[:, 0], k_avg[1], k_cur[1])
+                du = jnp.where(new_best, pick, du)
+                pick = jnp.where(use_avg[:, 0], k_avg[2], k_cur[2])
+                gap = jnp.where(new_best, pick, gap)
+                e_b = jnp.where(new_best, e_c, e_b)
+                need_col = need[:, None]
+                x_it = jnp.where(need_col, x1, x_it)
+                z_it = jnp.where(need_col, z1, z_it)
+                rounds = rounds + need.astype(jnp.int32)
+                return (x_it, z_it, xb, zb, e_b, pr, du, gap, rounds)
+
+            B = x_it.shape[0]
+            init_r = (x_it, z_it, x_it, z_it, e_b, pr, du, gap,
+                      jnp.zeros(B, jnp.int32))
+            (x_it, z_it, xb, zb, e_b, pr, du, gap, rounds) = \
+                jax.lax.while_loop(r_cond, r_body, init_r)
+            return xb, zb, pr, du, gap, rounds
 
     def solver(batched_params) -> LPResult:
         # batch axis = any leaf with one extra leading dim vs defaults;
@@ -522,11 +676,11 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             # restart lull, not the f32 floor, and exiting there costs
             # ~1.5e-4 objective error (past the 1e-4 parity budget)
             floored = jnp.logical_and(
-                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
-                s["it"] >= opt.stall_min_iters,
+                jnp.logical_and(e_b < 20.0 * tol_main, stall >= 12),
+                s["it"] >= stall_min,
             )
             done = jnp.logical_or(s["done"],
-                                  jnp.logical_or(e_b < opt.tol, floored))
+                                  jnp.logical_or(e_b < tol_main, floored))
             it_next = s["it"] + opt.check_every
             # per-lane iteration count, frozen when the lane finishes
             it_done = jnp.where(jnp.logical_and(done, ~s["done"]),
@@ -594,11 +748,11 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             zb = jnp.where(new_best[:, None], zc, s["zb"])
             stall = jnp.where(improved, 0, s["stall"] + 1)
             floored = jnp.logical_and(
-                jnp.logical_and(e_b < 20.0 * opt.tol, stall >= 12),
-                s["it"] >= opt.stall_min_iters,
+                jnp.logical_and(e_b < 20.0 * tol_main, stall >= 12),
+                s["it"] >= stall_min,
             )
             done = jnp.logical_or(s["done"],
-                                  jnp.logical_or(e_b < opt.tol, floored))
+                                  jnp.logical_or(e_b < tol_main, floored))
             it_next = s["it"] + opt.check_every
             it_done = jnp.where(jnp.logical_and(done, ~s["done"]),
                                 it_next, s["it_done"])
@@ -635,13 +789,20 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             "xr": x, "zr": z, "e_r": e0, "omega": omega0,
             "it": jnp.asarray(0, jnp.int32),
             "it_done": jnp.zeros(B, jnp.int32),
-            "done": e0 < opt.tol, "e_b": e0,
+            "done": e0 < tol_main, "e_b": e0,
             "stall": jnp.zeros(B, jnp.int32),
             "xb": x, "zb": z,
         }
         out = jax.lax.while_loop(cond, step, init)
         xb, zb = out["xb"], out["zb"]
-        pr, du, gap = _kkt_errors(xb, zb, c, b)
+        if plan.rounds:
+            xh, zh, pr, du, gap, refined = _refine(
+                xb, zb, c, b, out["omega"])
+            xb = xh.astype(dtype)
+            zb = zh.astype(dtype)
+        else:
+            pr, du, gap = _kkt_errors(xb, zb, c, b)
+            refined = jnp.zeros(B, jnp.int32)
         x_scaled = xb * dc_j[None, :]
         obj = jax.vmap(
             lambda xv, pv: nlp.user_objective(
@@ -659,6 +820,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             # (same back-out as pdlp.py's z=zb*dr_j): shadow-price/LMP
             # extraction works identically on both paths
             z=zb * dr_j[None, :],
+            refined=refined,
         )
 
     return solver

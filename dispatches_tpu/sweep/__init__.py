@@ -7,6 +7,7 @@ from dispatches_tpu.sweep.spec import Axis, SweepSpec, grid, lhs, synhist
 from dispatches_tpu.sweep.store import (
     STATUS_OK,
     STATUS_QUARANTINED,
+    STATUS_REFINE_FAILED,
     STATUS_RETRIED,
     ResultStore,
     format_report,
@@ -18,6 +19,7 @@ __all__ = [
     "ResultStore",
     "STATUS_OK",
     "STATUS_QUARANTINED",
+    "STATUS_REFINE_FAILED",
     "STATUS_RETRIED",
     "SweepData",
     "SweepOptions",

@@ -47,6 +47,7 @@ from dispatches_tpu.sweep.spec import SweepSpec
 from dispatches_tpu.sweep.store import (
     STATUS_OK,
     STATUS_QUARANTINED,
+    STATUS_REFINE_FAILED,
     STATUS_RETRIED,
     ResultStore,
 )
@@ -111,9 +112,10 @@ def _resolve_solver(nlp, solver, solver_options):
 
 
 def _extract(res, n_live: int):
-    """(obj, converged, iterations) host arrays from a batched result
-    pytree (IPMResult / LPResult / any ``.obj``-bearing tuple),
-    padding stripped."""
+    """(obj, converged, iterations, refined) host arrays from a batched
+    result pytree (IPMResult / LPResult / any ``.obj``-bearing tuple),
+    padding stripped.  ``refined`` is the per-lane iterative-refinement
+    epoch count (zeros for solvers without a mixed-precision tail)."""
     obj = np.asarray(np.asarray(res.obj)[:n_live], dtype=np.float64)
     conv = getattr(res, "converged", None)
     conv = (np.asarray(conv)[:n_live].astype(bool) if conv is not None
@@ -125,7 +127,14 @@ def _extract(res, n_live: int):
         it = np.asarray(it)
         iters = (np.full(n_live, int(it)) if it.ndim == 0
                  else it[:n_live]).astype(np.int64)
-    return obj, conv, iters
+    rf = getattr(res, "refined", None)
+    if rf is None:
+        refined = np.zeros(n_live, np.int64)
+    else:
+        rf = np.asarray(rf)
+        refined = (np.full(n_live, int(rf)) if rf.ndim == 0
+                   else rf[:n_live]).astype(np.int64)
+    return obj, conv, iters, refined
 
 
 def _pad_rows(values: Dict[str, np.ndarray], width: int):
@@ -172,10 +181,18 @@ def run_sweep(nlp, spec: SweepSpec, *,
             f"spec sweeps unknown param/fixed names {sorted(unknown)}")
 
     kind = opts.solver if isinstance(opts.solver, str) else "custom"
+    precision = None
+    if kind != "custom":
+        from dispatches_tpu.solvers.pdlp import resolve_pdlp_precision
+
+        # resolve (env override included) at plan time so the manifest
+        # pins the tier the objectives were actually solved at
+        precision = resolve_pdlp_precision(
+            (opts.solver_options or {}).get("precision"))
     store = ResultStore.open_or_create(
         store_dir if store_dir is not None else opts.result_dir,
         spec, opts.chunk_size, resume=resume, overwrite=overwrite,
-        backend=opts.backend, solver=kind,
+        backend=opts.backend, solver=kind, precision=precision,
         params_fingerprint=request_fingerprint(defaults))
 
     solve_chunk = _make_backend(nlp, opts, defaults, names_p, names_f,
@@ -193,22 +210,31 @@ def run_sweep(nlp, spec: SweepSpec, *,
         n_live = len(idxs)
         t0 = time.perf_counter()
         with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
-            obj, conv, iters = solve_chunk(values, n_live)
+            obj, conv, iters, refined = solve_chunk(values, n_live)
             status = np.zeros(n_live, dtype=np.int8)
             retries = np.zeros(n_live, dtype=np.int16)
             for j in np.where(~np.isfinite(obj))[0]:
                 for attempt in range(1, opts.max_retries + 1):
                     single = {k: np.asarray(v)[j:j + 1]
                               for k, v in values.items()}
-                    o1, c1, i1 = solve_chunk(single, 1)
+                    o1, c1, i1, r1 = solve_chunk(single, 1)
                     retries[j] = attempt
                     if np.isfinite(o1[0]):
                         obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
+                        refined[j] = r1[0]
                         status[j] = STATUS_RETRIED
                         break
                 else:
                     status[j] = STATUS_QUARANTINED
                     conv[j] = False
+            # a finite point that consumed refinement epochs yet still
+            # missed tol carries a low-tier-accuracy objective: keep it
+            # out of training_data (like non-finite quarantine) but
+            # distinct in --report so operators see the precision
+            # policy, not the model, failed
+            refine_failed = ((status < STATUS_QUARANTINED)
+                             & np.isfinite(obj) & ~conv & (refined > 0))
+            status[refine_failed] = STATUS_REFINE_FAILED
         store.record_chunk(cid, {
             "index": idxs.astype(np.int64),
             "obj": obj,
@@ -216,6 +242,7 @@ def run_sweep(nlp, spec: SweepSpec, *,
             "iterations": iters,
             "status": status,
             "retries": retries,
+            "refined": refined,
             "inputs": spec.inputs_for(idxs),
         }, time.perf_counter() - t0,
             extra=_chunk_cost_telemetry(opts, n_live))
@@ -298,15 +325,17 @@ def _ledger_record(store: ResultStore, opts: "SweepOptions",
             backend=jax.default_backend(),
             extra={"dispatch": opts.backend,
                    "chunks_done": s.get("chunks_done"),
-                   "algorithm": algorithm}))
+                   "algorithm": algorithm,
+                   "precision": store.precision,
+                   "refine_failed": s.get("refine_failed")}))
     except Exception:
         pass
 
 
 def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                   mesh=None, service=None):
-    """``solve_chunk(values, n_live) -> (obj, conv, iters)`` closure for
-    the configured backend."""
+    """``solve_chunk(values, n_live) -> (obj, conv, iters, refined)``
+    closure for the configured backend."""
     backend = opts.backend.lower()
     if backend == "direct":
         base, _ = _resolve_solver(nlp, opts.solver, opts.solver_options)
@@ -393,14 +422,16 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             obj = np.full(n_live, np.nan)
             conv = np.zeros(n_live, dtype=bool)
             iters = np.zeros(n_live, dtype=np.int64)
+            refined = np.zeros(n_live, dtype=np.int64)
             for i, r in enumerate(rs):
                 if r.status != RequestStatus.DONE:
                     continue
-                o, c, it = _extract(
+                o, c, it, rf = _extract(
                     jax.tree_util.tree_map(lambda a: np.asarray(a)[None],
                                            r.result), 1)
                 obj[i], conv[i], iters[i] = o[0], c[0], it[0]
-            return obj, conv, iters
+                refined[i] = rf[0]
+            return obj, conv, iters, refined
 
         return solve_chunk
 

@@ -36,11 +36,20 @@ import numpy as np
 
 from dispatches_tpu.utils.checkpoint import load_state, save_state
 
-__all__ = ["ResultStore", "STATUS_OK", "STATUS_RETRIED", "STATUS_QUARANTINED"]
+__all__ = ["ResultStore", "STATUS_OK", "STATUS_RETRIED",
+           "STATUS_QUARANTINED", "STATUS_REFINE_FAILED"]
 
 STATUS_OK = 0          # solved on the first batched attempt
 STATUS_RETRIED = 1     # non-finite in the batch, recovered on retry
 STATUS_QUARANTINED = 2  # non-finite after all retries; obj left as NaN
+# finite but did not reach tol even after consuming refinement epochs
+# (mixed-precision path): quarantined from training_data like
+# non-finite points — a 1e-3-accurate label silently poisons a
+# surrogate — but kept distinct so --report shows WHERE the precision
+# policy, not the model, is the problem.  Must compare >=
+# STATUS_QUARANTINED so the existing `status < STATUS_QUARANTINED`
+# training filter excludes it unchanged.
+STATUS_REFINE_FAILED = 3
 
 _MANIFEST = "manifest.json"
 _PROGRESS = "progress.json"
@@ -68,9 +77,13 @@ class ResultStore:
     @classmethod
     def create(cls, path, spec, chunk_size: int, *,
                backend: str = "direct", solver: str = "ipm",
+               precision: Optional[str] = None,
                params_fingerprint: Optional[str] = None) -> "ResultStore":
         """Initialise a sweep directory: full chunk plan up front (every
-        chunk ``pending``) so resume only ever flips statuses."""
+        chunk ``pending``) so resume only ever flips statuses.
+        ``precision`` is the RESOLVED solver precision tier — part of
+        the store identity, because bf16-inner objectives are not
+        interchangeable with f32 ones as surrogate labels."""
         path = Path(path)
         (path / "chunks").mkdir(parents=True, exist_ok=True)
         n = spec.n_points
@@ -90,6 +103,7 @@ class ResultStore:
             "chunk_size": int(chunk_size),
             "backend": backend,
             "solver": solver,
+            "precision": precision,
             "input_names": list(spec.input_names),
             "axes": spec.describe(),
             "chunks": chunks,
@@ -101,6 +115,7 @@ class ResultStore:
     def open_or_create(cls, path, spec, chunk_size: int, *,
                        resume: bool = False, overwrite: bool = False,
                        backend: str = "direct", solver: str = "ipm",
+                       precision: Optional[str] = None,
                        params_fingerprint: Optional[str] = None,
                        ) -> "ResultStore":
         path = Path(path)
@@ -125,9 +140,17 @@ class ResultStore:
                     raise ValueError(
                         "resume refused: base params differ from the "
                         "run that created this store")
+                if (precision is not None
+                        and store.precision is not None
+                        and store.precision != precision):
+                    raise ValueError(
+                        "resume refused: solver precision "
+                        f"{precision!r} differs from the "
+                        f"{store.precision!r} this store was created "
+                        "with (objectives would mix accuracy tiers)")
                 return store
         return cls.create(path, spec, chunk_size, backend=backend,
-                          solver=solver,
+                          solver=solver, precision=precision,
                           params_fingerprint=params_fingerprint)
 
     # -- identity / plan ---------------------------------------------------
@@ -139,6 +162,12 @@ class ResultStore:
     @property
     def params_fingerprint(self) -> Optional[str]:
         return self._manifest.get("params_fingerprint")
+
+    @property
+    def precision(self) -> Optional[str]:
+        """Resolved solver precision tier this store was created with
+        (None on stores that predate the precision axis)."""
+        return self._manifest.get("precision")
 
     @property
     def n_points(self) -> int:
@@ -249,6 +278,8 @@ class ResultStore:
             "chunks_done": len(done),
             "chunks_total": total_chunks,
         }
+        if self.precision is not None:
+            out["precision"] = self.precision
         if done:
             a = self.arrays(require_complete=False)
             st = a["status"]
@@ -257,6 +288,7 @@ class ResultStore:
                 ok=int(np.sum(st == STATUS_OK)),
                 retried=int(np.sum(st == STATUS_RETRIED)),
                 quarantined=int(np.sum(st == STATUS_QUARANTINED)),
+                refine_failed=int(np.sum(st == STATUS_REFINE_FAILED)),
                 converged=int(np.sum(a["converged"])),
                 iterations_mean=float(np.mean(a["iterations"])),
             )
@@ -283,17 +315,23 @@ class ResultStore:
 
 def format_report(summary: Dict) -> str:
     """Human-readable progress/throughput report from ``summary()``."""
+    solver_bits = f"solver {summary.get('solver')}"
+    if summary.get("precision"):
+        solver_bits += f" ({summary['precision']})"
     lines = [
         f"sweep {summary['fingerprint'][:12]} at {summary['path']}",
-        f"  backend {summary.get('backend')} · solver "
-        f"{summary.get('solver')} · chunk size {summary['chunk_size']}",
+        f"  backend {summary.get('backend')} · {solver_bits}"
+        f" · chunk size {summary['chunk_size']}",
         f"  chunks {summary['chunks_done']}/{summary['chunks_total']} done"
         f" · {summary['n_points']} points planned",
     ]
     if "points_done" in summary:
+        refine = (f" · {summary['refine_failed']} refine-failed"
+                  if summary.get("refine_failed") else "")
         lines.append(
             f"  status: {summary['ok']} ok · {summary['retried']} retried"
-            f" · {summary['quarantined']} quarantined · converged "
+            f" · {summary['quarantined']} quarantined{refine}"
+            f" · converged "
             f"{summary['converged']}/{summary['points_done']}")
     if "wall_s" in summary:
         tail = (f" · {summary['solves_per_sec_steady']} steady"

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r06_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r07_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -61,6 +61,31 @@ def test_preview_pdlp_variant_ab(bench):
     assert out["pdhg_iters_mean"] > 0
 
 
+def test_preview_pdlp_precision_ab(bench):
+    """The pinned preview carries the f32-vs-bf16x-f32 A/B section and
+    the recorded run backs the mixed-precision acceptance claim: the
+    bf16 inner loop plus high-precision iterative refinement stays
+    inside the 1e-4 objective budget while beating the f32 build's
+    throughput on this backend (ratio recorded in the section)."""
+    out = json.load(open(PREVIEW))
+    tiers = out["pdlp_precision"]
+    for prec in bench.PDLP_PRECISION_TIERS:
+        for key in bench.PDLP_PRECISION_KEYS:
+            assert key in tiers[prec], (prec, key)
+        assert tiers[prec]["obj_rel_err_vs_highs"] <= 1e-4
+    # refinement actually engaged on the low-precision tier, and the
+    # f32 tier (no bf16 floor to polish away) recorded zero rounds
+    assert tiers["bf16x-f32"]["refine_rounds_mean"] > 0
+    assert tiers["f32"]["refine_rounds_mean"] == 0
+    ratio = (tiers["bf16x-f32"]["solves_per_sec"]
+             / tiers["f32"]["solves_per_sec"])
+    assert tiers["sps_ratio_bf16_vs_f32"] == pytest.approx(ratio, abs=1e-3)
+    # acceptance: bf16+refinement beats f32 on the recorded backend
+    assert tiers["sps_ratio_bf16_vs_f32"] > 1.0
+    # the headline record must declare the precision it ran at
+    assert out["pdlp_precision_resolved"] in ("f32", "bf16x-f32", "f32-f64")
+
+
 def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["vs_baseline"]
@@ -86,6 +111,18 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["pdlp_variant"]
+    bench.validate_bench_output(out)
+    # same optional-but-complete contract for the precision A/B section
+    out = json.load(open(PREVIEW))
+    del out["pdlp_precision"]["bf16x-f32"]["refine_rounds_mean"]
+    with pytest.raises(ValueError, match="refine_rounds_mean"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["pdlp_precision"]["sps_ratio_bf16_vs_f32"]
+    with pytest.raises(ValueError, match="sps_ratio"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["pdlp_precision"]
     bench.validate_bench_output(out)
 
 

@@ -256,17 +256,32 @@ def test_ipm_trace_mu_monotone_and_bitwise_parity():
     assert "kkt_error" in ct.columns and "iter" in ct.format()
 
 
-@pytest.mark.parametrize("algorithm", ["avg", "halpern"])
-def test_pdlp_trace_gap_at_reported_iteration_and_parity(algorithm):
-    """Both PDLP algorithms: trace=True must not perturb the solve
-    (bitwise x parity) and the trace's best-iterate row at the reported
-    iteration is exactly what the LPResult certifies."""
+@pytest.mark.parametrize("algorithm,precision", [
+    ("avg", "f32"),
+    ("halpern", "f32"),
+    # one low-tier combo: the traced main loop stops at the bf16 KKT
+    # floor and the refinement tail runs AFTER it, untraced — parity
+    # and iteration alignment must survive that split.  Slow lane: the
+    # tier-1 budget sits at the 870 s cap and this combo pays two fresh
+    # XLA compiles; the f32 combos keep tier-1 parity coverage.
+    pytest.param("halpern", "bf16x-f32", marks=pytest.mark.skipif(
+        not os.environ.get("DISPATCHES_TPU_SLOW"),
+        reason="slow lane (DISPATCHES_TPU_SLOW=1)")),
+])
+def test_pdlp_trace_gap_at_reported_iteration_and_parity(
+        algorithm, precision):
+    """Every (algorithm, precision) combo: trace=True must not perturb
+    the solve (bitwise x parity) and the trace's best-iterate row at
+    the reported iteration is exactly what the LPResult certifies."""
     from dispatches_tpu.serve.__main__ import _arbitrage_nlp
     from dispatches_tpu.solvers.pdlp import PDLPOptions, make_pdlp_solver
 
     nlp = _arbitrage_nlp(6)
     params = nlp.default_params()
-    opts = PDLPOptions(dtype="float64", tol=1e-8, algorithm=algorithm)
+    low = precision == "bf16x-f32"
+    opts = PDLPOptions(dtype="float32" if low else "float64",
+                       tol=1e-5 if low else 1e-8,
+                       algorithm=algorithm, precision=precision)
     res0 = jax.jit(make_pdlp_solver(nlp, opts))(params)
     res1, tr = jax.jit(make_pdlp_solver(nlp, opts, trace=True))(params)
 
@@ -278,7 +293,15 @@ def test_pdlp_trace_gap_at_reported_iteration_and_parity(algorithm):
     # exactly what the LPResult certifies
     assert float(ct["gap"][-1]) == float(res1.gap)
     assert float(ct["gap"][-1]) <= opts.tol
-    assert float(ct["err_best"][-1]) <= opts.tol
+    if low:
+        # the traced loop alone could NOT certify tol: its best err sits
+        # at the bf16 floor, and the (untraced) high-precision tail did
+        # the rest — LPResult.refined says so
+        assert int(res1.refined) >= 1
+        assert float(ct["err_best"][-1]) > opts.tol
+    else:
+        assert int(res1.refined) == 0
+        assert float(ct["err_best"][-1]) <= opts.tol
 
 
 def test_newton_trace_residual_and_parity():

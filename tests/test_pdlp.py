@@ -108,6 +108,9 @@ def test_pdlp_battery_lp_parity_f32_batch():
     res = vsolve(batched)
     objs = np.asarray(res.obj)
     assert bool(np.all(np.asarray(res.converged)))
+    # f32 is the no-refinement fast path: the default precision policy
+    # must never spend refinement epochs here
+    assert int(np.max(np.asarray(res.refined))) == 0
     for i in range(N):
         ref = _highs_battery(T, lmps[i], cfs[i])
         assert objs[i] == pytest.approx(ref, rel=1e-4), f"scenario {i}"
@@ -258,6 +261,88 @@ def test_resolve_pdlp_algorithm(monkeypatch):
     monkeypatch.setenv("DISPATCHES_TPU_PDLP_ALGO", "newton")
     with pytest.raises(ValueError, match="newton"):
         resolve_pdlp_algorithm()
+
+
+def test_resolve_pdlp_precision(monkeypatch):
+    """Same resolution rule as the algorithm knob: env override beats
+    the explicit argument beats the PDLPOptions default; junk raises."""
+    from dispatches_tpu.solvers.pdlp import resolve_pdlp_precision
+
+    monkeypatch.delenv("DISPATCHES_TPU_PDLP_PRECISION", raising=False)
+    assert resolve_pdlp_precision() == PDLPOptions.precision
+    assert resolve_pdlp_precision("f32") == "f32"
+    assert resolve_pdlp_precision("BF16x-F32") == "bf16x-f32"
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_PRECISION", "f32-f64")
+    assert resolve_pdlp_precision("f32") == "f32-f64"
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_PRECISION", "fp8")
+    with pytest.raises(ValueError, match="fp8"):
+        resolve_pdlp_precision()
+
+
+def test_resolve_pdlp_refine_rounds(monkeypatch):
+    from dispatches_tpu.solvers.pdlp import resolve_pdlp_refine_rounds
+
+    monkeypatch.delenv("DISPATCHES_TPU_PDLP_REFINE_ROUNDS", raising=False)
+    assert resolve_pdlp_refine_rounds() == PDLPOptions.refine_rounds
+    assert resolve_pdlp_refine_rounds(2) == 2
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_REFINE_ROUNDS", "5")
+    assert resolve_pdlp_refine_rounds(1) == 5
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_REFINE_ROUNDS", "-1")
+    with pytest.raises(ValueError, match="-1"):
+        resolve_pdlp_refine_rounds()
+
+
+def test_pdlp_bf16_refinement_recovers_accuracy():
+    """The mixed-precision tentpole at smoke scale: bf16 inner
+    iterations alone cannot certify 1e-4 objective parity, but the
+    high-precision iterative-refinement tail restores it.  The result
+    must report that refinement actually ran (LPResult.refined > 0)."""
+    T = 8
+    nlp = _battery_lp(T)
+    solver = make_pdlp_solver(
+        nlp, PDLPOptions(tol=1e-5, dtype="float32", precision="bf16x-f32"))
+    res = jax.jit(solver)(nlp.default_params())
+    assert bool(res.converged)
+    assert int(res.refined) > 0
+    ref = _highs_battery(T, np.full(T, 0.02), np.full(T, 400e3))
+    assert float(res.obj) == pytest.approx(ref, rel=1e-4)
+
+
+@pytest.mark.skipif(not flag_enabled("SLOW"),
+                    reason="slow lane (DISPATCHES_TPU_SLOW=1)")
+def test_pdlp_bf16_refined_lanewise_highs_parity():
+    """Lane-wise HiGHS parity for the refined bf16 path, mirroring the
+    halpern batch parity test above: every lane of the vmapped solver
+    with ``precision="bf16x-f32"`` meets the 1e-4 objective budget
+    against its own independently assembled HiGHS reference, and the
+    refinement tail engages on at least one lane (the bf16 KKT floor
+    sits well above tol=1e-5 on this workload)."""
+    T = 24
+    nlp = _battery_lp(T)
+    params = nlp.default_params()
+    rng = np.random.default_rng(11)
+    N = 8
+    lmps = 0.02 + 0.015 * np.sin(
+        2 * np.pi * (np.arange(T)[None, :] + rng.uniform(0, 24, (N, 1))) / 24
+    )
+    cfs = 400e3 * (0.4 + 0.6 * rng.random((N, T)))
+    batched = {"p": {"lmp": lmps, "wind_cap_cf": cfs},
+               "fixed": params["fixed"]}
+    axes = ({"p": {"lmp": 0, "wind_cap_cf": 0}, "fixed": None},)
+    # refine_rounds=6: one seed-11 lane needs a 4th refinement epoch to
+    # certify convergence (at the default cap of 3 it lands refined-but-
+    # unconverged — the exact state the sweep engine quarantines as
+    # STATUS_REFINE_FAILED — while already inside the 1e-4 budget)
+    solver = make_pdlp_solver(
+        nlp, PDLPOptions(tol=1e-5, dtype="float32", precision="bf16x-f32",
+                         refine_rounds=6))
+    res = jax.jit(jax.vmap(solver, in_axes=axes))(batched)
+    assert bool(np.all(np.asarray(res.converged)))
+    assert int(np.max(np.asarray(res.refined))) > 0
+    objs = np.asarray(res.obj)
+    for i in range(N):
+        ref = _highs_battery(T, lmps[i], cfs[i])
+        assert objs[i] == pytest.approx(ref, rel=1e-4), f"lane {i}"
 
 
 @pytest.mark.skipif(not flag_enabled("SLOW"),

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dispatches_tpu.analysis.flags import flag_enabled
 from dispatches_tpu.case_studies.renewables.wind_battery_lmp import (
     wind_battery_pricetaker_nlp,
 )
@@ -178,6 +179,80 @@ def test_pallas_halpern_sweep_matches_xla(nlp):
     for got, want in zip(out_p, out_x):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.skipif(not flag_enabled("SLOW"),
+                    reason="slow lane (DISPATCHES_TPU_SLOW=1)")
+def test_pallas_bf16_sweep_matches_xla(nlp):
+    """The low-precision kernel tier truncates EXACTLY like the XLA
+    fallback: both cast the operands to bfloat16 and accumulate in f32
+    (``preferred_element_type``), so interpreter mode on CPU must match
+    an XLA transcription of the same bf16 recipe bit-for-bit — the
+    property that lets the batch refinement tail treat either backend's
+    bf16 iterates interchangeably."""
+    data = make_lp_data(nlp)
+    K, G = data["K"], data["G"]
+    A = np.vstack([K, G]) if G.shape[0] else K
+    dr, dc = _ruiz_equilibrate(A, 10)
+    Ah = (dr[:, None] * A * dc[None, :]).astype(np.float32)
+    m, n = Ah.shape
+    lb = (data["lb"] / dc).astype(np.float32)
+    ub = (data["ub"] / dc).astype(np.float32)
+    eq = np.concatenate(
+        [np.ones(K.shape[0]), np.zeros(G.shape[0])]).astype(np.float32)
+
+    rng = np.random.default_rng(13)
+    B, k = 8, 24
+    x = np.clip(rng.standard_normal((B, n)).astype(np.float32), lb, ub)
+    z = rng.standard_normal((B, m)).astype(np.float32)
+    xs = np.zeros_like(x)
+    zs = np.zeros_like(z)
+    c = 0.1 * rng.standard_normal((B, n)).astype(np.float32)
+    b = 0.1 * rng.standard_normal((B, m)).astype(np.float32)
+    tau = (0.5 / _power_norm(Ah) * np.ones((B, 1))).astype(np.float32)
+    sig = tau.copy()
+
+    sweep_p = _pallas_sweep_fn(jnp.asarray(Ah), jnp.asarray(Ah.T),
+                               lb, ub, eq, k, lanes_per_block=8,
+                               interpret=True, low_precision=True)
+    out_p = sweep_p(*map(jnp.asarray, (x, z, xs, zs, c, b, tau, sig)))
+
+    A_lo = jnp.asarray(Ah).astype(jnp.bfloat16)
+    AT_lo = jnp.asarray(Ah.T).astype(jnp.bfloat16)
+
+    def dot_lo(u, M):
+        return jax.lax.dot_general(
+            u.astype(jnp.bfloat16), M,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def sweep_x(x, z, xs, zs, c, b, tau, sig):
+        def body(carry, _):
+            x, z, xs, zs = carry
+            grad = c + dot_lo(z, A_lo)
+            xn = jnp.clip(x - tau * grad, lb[None, :], ub[None, :])
+            zt = z + sig * (dot_lo(2 * xn - x, AT_lo) - b)
+            zn = jnp.where(eq[None, :] > 0.5, zt, jnp.clip(zt, 0.0, None))
+            return (xn, zn, xs + xn, zs + zn), None
+
+        (x, z, xs, zs), _ = jax.lax.scan(
+            body, (x, z, xs, zs), None, length=k)
+        return x, z, xs, zs
+
+    out_x = sweep_x(*map(jnp.asarray, (x, z, xs, zs, c, b, tau, sig)))
+    for got, want in zip(out_p, out_x):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    # and the bf16 tier genuinely differs from the full-precision tier
+    # (same seed, same steps): the truncation the refinement tail exists
+    # to repair is real, not a no-op cast
+    sweep_hi = _pallas_sweep_fn(jnp.asarray(Ah), jnp.asarray(Ah.T),
+                                lb, ub, eq, k, lanes_per_block=8,
+                                interpret=True)
+    out_hi = sweep_hi(*map(jnp.asarray, (x, z, xs, zs, c, b, tau, sig)))
+    assert float(np.max(np.abs(np.asarray(out_hi[0])
+                               - np.asarray(out_p[0])))) > 0
 
 
 def test_batch_axis_validation(nlp):

@@ -138,6 +138,59 @@ def test_request_fingerprint_distinguishes_values():
     assert request_fingerprint(a) != request_fingerprint(b)
 
 
+def test_precision_folds_into_bucket_fingerprint(nlp8, monkeypatch):
+    """Resolved PDLP precision is part of the bucket key: requests that
+    resolve to different tiers must never share a compiled program (the
+    jaxprs differ), while the same resolved tier — however it was
+    spelled — reuses the bucket.  Host-side only: buckets compile
+    lazily at flush, so no XLA cost here."""
+    monkeypatch.delenv("DISPATCHES_TPU_PDLP_PRECISION", raising=False)
+    svc = SolveService(ServeOptions(max_wait_ms=1e9), clock=FakeClock())
+    params = nlp8.default_params()
+    opts = {"tol": 1e-6, "dtype": "float32"}
+    b_f32 = svc._bucket_for(nlp8, "pdlp", dict(opts), params, None)
+    assert b_f32.precision == "f32"
+
+    # env override re-routes to a distinct bucket...
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_PRECISION", "bf16x-f32")
+    b_lo = svc._bucket_for(nlp8, "pdlp", dict(opts), params, None)
+    assert b_lo is not b_f32
+    assert b_lo.precision == "bf16x-f32"
+
+    # ...and dropping it again reuses the original f32 bucket
+    monkeypatch.delenv("DISPATCHES_TPU_PDLP_PRECISION", raising=False)
+    assert svc._bucket_for(nlp8, "pdlp", dict(opts), params, None) is b_f32
+
+    # explicit per-request option resolves to the same bucket as the
+    # env spelling did: the key is the RESOLVED tier, not the source
+    b_opt = svc._bucket_for(
+        nlp8, "pdlp", {**opts, "precision": "bf16x-f32"}, params, None)
+    assert b_opt is b_lo
+
+    # ServeOptions.pdlp_precision sets the service-wide default tier
+    svc2 = SolveService(
+        ServeOptions(max_wait_ms=1e9, pdlp_precision="bf16x-f32"),
+        clock=FakeClock())
+    b_def = svc2._bucket_for(nlp8, "pdlp", dict(opts), params, None)
+    assert b_def.precision == "bf16x-f32"
+
+
+def test_warm_start_ingest_casts_to_bucket_dtype(nlp8):
+    """A caller-supplied (or cached) x0 lands in the handle already cast
+    to the bucket's compiled dtype: a f32 warm start submitted to a f64
+    bucket must not poison the batch with a dtype mismatch (regression
+    guard for the warm-start cache handing f64 vectors to bf16/f32
+    precision buckets).  submit() only — no flush, so no compile; IPM
+    buckets are the warm-started kind (pdlp lanes take no x0)."""
+    svc = SolveService(ServeOptions(max_wait_ms=1e9), clock=FakeClock())
+    x0_f32 = np.asarray(nlp8.x0, np.float32) * np.asarray(
+        nlp8.var_scale, np.float32)
+    h = svc.submit(nlp8, solver="ipm", x0=x0_f32)
+    bucket = h._bucket
+    assert bucket.default_x0.dtype == np.float64
+    assert h.x0.dtype == bucket.default_x0.dtype
+
+
 # ---------------------------------------------------------------------
 # the steady-state acceptance test
 # ---------------------------------------------------------------------

@@ -338,6 +338,55 @@ def test_nonfinite_points_quarantined_not_poisoning(nlp, tmp_path):
     assert store.summary()["quarantined"] == 2
 
 
+class RefinedResult(NamedTuple):
+    obj: jnp.ndarray
+    converged: jnp.ndarray
+    iterations: jnp.ndarray
+    refined: jnp.ndarray
+
+
+def _refine_capped_solver(params):
+    """Stand-in mixed-precision kernel: every point refines at least
+    once; points whose price[0] > 8 exhaust the refinement budget and
+    come back finite but unconverged — the bf16-floor failure mode,
+    distinct from a diverged (non-finite) solve."""
+    price = params["p"]["price"]
+    hard = price[0] > 8.0
+    return RefinedResult(jnp.sum(price), ~hard, jnp.asarray(3),
+                         jnp.where(hard, 3, 1).astype(jnp.int32))
+
+
+def test_refine_failed_points_get_distinct_status(nlp, tmp_path):
+    """A finite-but-unconverged point that SPENT refinement rounds is
+    STATUS_REFINE_FAILED, not OK and not lumped with the non-finite
+    quarantine: its objective is real data a human may inspect, but the
+    surrogate handoff must still exclude it, and --report must show the
+    count."""
+    from dispatches_tpu.sweep import STATUS_REFINE_FAILED, format_report
+
+    rng = np.random.default_rng(4)
+    profiles = rng.uniform(1.0, 7.0, (8, T))
+    profiles[1, 0] = 9.5
+    profiles[6, 0] = 9.9
+    spec = SweepSpec((grid("price", profiles),))
+    store = run_sweep(
+        nlp, spec, store_dir=tmp_path / "rf",
+        options=SweepOptions(chunk_size=4, solver=_refine_capped_solver,
+                             max_retries=2))
+    a = store.arrays()
+    assert list(a["status"]) == [0, 3, 0, 0, 0, 0, 3, 0]
+    assert STATUS_REFINE_FAILED == 3
+    # unlike quarantine, the objective stays finite and recorded…
+    np.testing.assert_allclose(a["obj"], profiles.sum(axis=1))
+    assert list(a["refined"]) == [1, 3, 1, 1, 1, 1, 3, 1]
+    # …but the surrogate handoff filters it exactly like quarantine
+    X, y = store.training_data()
+    assert len(y) == 6
+    s = store.summary()
+    assert s["refine_failed"] == 2 and s["quarantined"] == 0
+    assert "2 refine-failed" in format_report(s)
+
+
 # -- backends ----------------------------------------------------------
 
 
