@@ -244,6 +244,12 @@ PDLP_PRECISION_KEYS = ("pdhg_iters_mean", "solves_per_sec",
                        "obj_rel_err_vs_highs", "refine_rounds_mean",
                        "peak_bytes")
 PDLP_PRECISION_TIERS = ("f32", "bf16x-f32")
+#: sub-keys of the ``serve`` section; the SLO tail metrics may be None
+#: on records predating them, but the keys must be present
+SERVE_KEYS = ("n_requests", "max_batch", "requests_done", "solves_per_sec",
+              "slab_solves_per_sec", "overhead_vs_slab", "occupancy_mean",
+              "compile_count", "programs", "serve_p99_ms",
+              "deadline_miss_rate")
 
 
 def validate_bench_output(out):
@@ -282,6 +288,11 @@ def validate_bench_output(out):
         if "sps_ratio_bf16_vs_f32" not in precision:
             raise ValueError(
                 "bench pdlp_precision missing 'sps_ratio_bf16_vs_f32'")
+    serve = out.get("serve")
+    if serve is not None:
+        missing = [k for k in SERVE_KEYS if k not in serve]
+        if missing:
+            raise ValueError(f"bench serve missing sub-keys: {missing}")
     return out
 
 
@@ -305,6 +316,11 @@ def _finalize_output(out):
         serve = out.get("serve") or {}
         if serve.get("compile_count") is not None:
             metrics["compile_count"] = serve["compile_count"]
+        # serve-path SLO metrics, gated in the ledger (lower is better)
+        if serve.get("serve_p99_ms") is not None:
+            metrics["serve_p99_ms"] = serve["serve_p99_ms"]
+        if serve.get("deadline_miss_rate") is not None:
+            metrics["deadline_miss_rate"] = serve["deadline_miss_rate"]
         # iteration count is a gated metric (lower is better): the
         # guardrail for the reflected-Halpern solver upgrade
         if out.get("pdhg_iters_mean") is not None:
@@ -641,6 +657,8 @@ def run_bench():
         t0 = time.perf_counter()
         jax.block_until_ready(slab(bp))
         slab_s = time.perf_counter() - t0
+        lat = sm.get("latency") or {}
+        dl = sm.get("deadline") or {}
         out["serve"] = {
             "n_requests": n_serve,
             "max_batch": serve_batch,
@@ -651,6 +669,12 @@ def run_bench():
             "occupancy_mean": sm["occupancy_mean"],
             "compile_count": sm["compile_count"],
             "programs": sm["programs"],
+            # SLO-facing tail metrics (gated in the perf ledger): p99
+            # end-to-end request latency over the measured round, and
+            # the deadline-miss fraction (0.0 here — the bench stream
+            # carries no deadlines — but the key is the contract)
+            "serve_p99_ms": lat.get("p99_ms"),
+            "deadline_miss_rate": dl.get("miss_rate"),
         }
     except Exception as exc:  # telemetry must never kill the headline
         out["serve_bench_error"] = str(exc)[:120]

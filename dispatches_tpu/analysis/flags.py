@@ -54,6 +54,13 @@ REGISTERED_FLAGS = {
     "OBS_LEDGER_TOL": "perf-ledger regression tolerance as a fraction "
     "of the trailing-window median (obs.ledger --check-regressions; "
     "default 0.3)",
+    "OBS_FLIGHT_DIR": "flight-recorder bundle directory; setting it "
+    "arms the trigger hooks (deadline miss, quarantine/refine-fail, "
+    "nan-guard trip, solver non-convergence) in serve/sweep/runtime "
+    "(obs.flight; unset = recorder disarmed, zero writes)",
+    "OBS_SLO": "default SLO spec JSON path for `python -m "
+    "dispatches_tpu.obs --slo` (obs.slo; unset = built-in example "
+    "objectives)",
     "PDLP_ALGO": "override PDLPOptions.algorithm ('avg' | 'halpern') "
     "for every PDLP consumer (solvers.pdlp.resolve_pdlp_algorithm; "
     "read at solver-build time)",

@@ -226,6 +226,14 @@ def _record(label: str, ok) -> None:
             SanitizeWarning,
             stacklevel=2,
         )
+        try:  # lazy, like _emit_compile_event: keeps the import discipline
+            from dispatches_tpu.obs import flight
+
+            if flight.enabled():
+                flight.trigger("nan_guard", label=label,
+                               detail={"guard": label})
+        except Exception:
+            pass
 
 
 def nan_guard(label: str, *arrays) -> None:

@@ -150,6 +150,8 @@ CORPUS: Dict[str, Dict[str, str]] = {
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_CHUNK")
             prof = os.environ.get("DISPATCHES_TPU_OBS_PROFILE")
             led_dir = os.environ.get("DISPATCHES_TPU_OBS_LEDGER_DIR")
+            flight = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_DIR")
+            slo = os.environ.get("DISPATCHES_TPU_OBS_SLO")
             algo = os.environ.get("DISPATCHES_TPU_PDLP_ALGO")
             prec = os.environ.get("DISPATCHES_TPU_PDLP_PRECISION")
             rounds = os.environ.get("DISPATCHES_TPU_PDLP_REFINE_ROUNDS")

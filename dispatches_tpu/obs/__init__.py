@@ -11,7 +11,12 @@ Three pieces, one import surface:
 * :mod:`~dispatches_tpu.obs.profile` — opt-in AOT cost/memory cost
   cards per ``graft_jit`` compile (``DISPATCHES_TPU_OBS_PROFILE``);
 * :mod:`~dispatches_tpu.obs.ledger` — append-only JSONL perf ledger
-  with the ``--check-regressions`` CI gate.
+  with the ``--check-regressions`` CI gate;
+* :mod:`~dispatches_tpu.obs.slo` — declarative SLO objectives graded
+  from registry snapshots (``--slo [--check]``);
+* :mod:`~dispatches_tpu.obs.flight` — triggered flight recorder
+  dumping diagnostic bundles on anomalies
+  (``DISPATCHES_TPU_OBS_FLIGHT_DIR``; ``--flight``).
 
 Everything here is disabled by default; set ``DISPATCHES_TPU_OBS=1``
 (or call :func:`enable`) to record, and run
@@ -49,5 +54,7 @@ from dispatches_tpu.obs.report import (  # noqa: F401
     aggregate_spans,
     format_report,
     load_chrome_trace,
+    request_journey,
+    validate_chrome_trace,
 )
-from dispatches_tpu.obs import ledger, profile  # noqa: F401
+from dispatches_tpu.obs import flight, ledger, profile, slo  # noqa: F401

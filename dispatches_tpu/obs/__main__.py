@@ -17,6 +17,13 @@
     python -m dispatches_tpu.obs --ledger [--json] [--ledger-dir DIR]
     python -m dispatches_tpu.obs --check-regressions [--ledger-dir DIR]
 
+    # SLO attainment + burn from the registry quantiles (--check exits
+    # non-zero when an objective with data is violated)
+    python -m dispatches_tpu.obs --slo [--json] [--slo-spec PATH] [--check]
+
+    # flight-recorder bundles (DISPATCHES_TPU_OBS_FLIGHT_DIR)
+    python -m dispatches_tpu.obs --flight [--json] [--flight-dir DIR]
+
 The demo workload is a small batch-serve session (the same battery
 arbitrage LP the serve CLI uses) with obs force-enabled, so the report
 exercises the real instrumentation: serve batch spans, ``graft_jit``
@@ -84,10 +91,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--tol", type=float, default=None,
                         help="regression tolerance fraction (default: the "
                              "DISPATCHES_TPU_OBS_LEDGER_TOL flag, then 0.3)")
+    parser.add_argument("--slo", action="store_true",
+                        help="grade SLO objectives from the registry "
+                             "quantiles (runs the demo workload when the "
+                             "live registry has no serve data)")
+    parser.add_argument("--slo-spec", metavar="PATH", default=None,
+                        help="SLO spec JSON (default: the "
+                             "DISPATCHES_TPU_OBS_SLO flag, then the "
+                             "built-in example objectives)")
+    parser.add_argument("--metrics-file", metavar="PATH", default=None,
+                        help="with --slo: grade a saved registry snapshot "
+                             "JSON instead of the live process")
+    parser.add_argument("--check", action="store_true",
+                        help="with --slo: exit 1 when any objective with "
+                             "data is violated (no-data objectives "
+                             "soft-pass)")
+    parser.add_argument("--flight", action="store_true",
+                        help="list flight-recorder bundles (--json dumps "
+                             "their full contents)")
+    parser.add_argument("--flight-dir", metavar="DIR", default=None,
+                        help="bundle directory (default: the "
+                             "DISPATCHES_TPU_OBS_FLIGHT_DIR flag)")
     args = parser.parse_args(argv)
 
     if args.ledger or args.check_regressions:
         return _ledger_main(args)
+    if args.slo:
+        return _slo_main(args)
+    if args.flight:
+        return _flight_main(args)
 
     if not (args.report or args.export_trace):
         parser.print_help()
@@ -122,6 +154,52 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(report.format_report(events, snapshot,
                                        dropped=trace.dropped()), end="")
+    return 0
+
+
+def _slo_main(args) -> int:
+    from dispatches_tpu.obs import slo
+
+    spec = slo.load_spec(args.slo_spec)
+    if args.metrics_file:
+        with open(args.metrics_file) as f:
+            snapshot = json.load(f)
+    else:
+        snapshot = registry.default_registry().snapshot()
+        if "serve.latency_ms" not in snapshot:
+            # cold process: grade a real (small) serve run, like --report
+            trace.enable(True)
+            _demo_workload()
+            snapshot = registry.default_registry().snapshot()
+    rows = slo.evaluate(spec, snapshot)
+    bad = slo.violations(rows)
+    if args.json:
+        print(json.dumps({"spec": spec.name, "results": rows,
+                          "ok": not bad}, indent=2, sort_keys=True))
+    else:
+        print(slo.format_results(spec, rows))
+    return 1 if (args.check and bad) else 0
+
+
+def _flight_main(args) -> int:
+    from dispatches_tpu.obs import flight
+
+    directory = args.flight_dir
+    found = flight.bundles(directory, full=args.json)
+    if args.json:
+        print(json.dumps({"bundles": found}, indent=2, sort_keys=True,
+                         default=str))
+    else:
+        if not found:
+            print("no flight bundles"
+                  + (f" in {directory}" if directory else
+                     " (set DISPATCHES_TPU_OBS_FLIGHT_DIR or "
+                     "--flight-dir)"))
+        for b in found:
+            rid = b.get("request_id")
+            print(f"{b['path']}: {b['kind']}"
+                  + (f" request_id={rid}" if rid is not None else "")
+                  + (f" bucket={b['bucket']}" if b.get("bucket") else ""))
     return 0
 
 

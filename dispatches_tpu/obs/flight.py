@@ -1,0 +1,248 @@
+"""Triggered flight recorder: atomic diagnostic bundles on anomalies.
+
+The trace ring is a flight recorder with nobody pulling the tape: by the
+time an operator asks why a point was quarantined or a request blew its
+deadline, the evidence has been overwritten.  This module pulls the tape
+at the moment of the anomaly.  Trigger hooks sit on the paths that
+already classify failure — serve's deadline handling, sweep's
+quarantine/refine-fail statuses, the nan-guard callback, solver
+non-convergence at dispatch — and each call to :func:`trigger` dumps one
+self-contained JSON bundle:
+
+* the trace-ring tail (last :data:`TAIL_EVENTS` events, Chrome-trace
+  shaped) and the drop counter,
+* a full metrics snapshot plus the diff against the previous bundle
+  (first bundle diffs against the registry state when the recorder
+  module loaded),
+* the triggering request's context — ``request_id``, bucket, params
+  fingerprint, solver options — as passed by the hook,
+* the latest AOT cost card for the triggering kernel label (when
+  ``obs.profile`` is on) and a solverlog convergence tail (when the
+  caller holds one, i.e. the solver was built with ``trace=True``).
+
+Armed iff ``DISPATCHES_TPU_OBS_FLIGHT_DIR`` is set (or :func:`enable`
+pointed it at a directory for the process).  Disarmed, the recorder is
+**zero overhead**: hooks guard on :func:`enabled` before assembling any
+context, and the spy-pinned test asserts no bundle write is ever
+reached — the ``obs.profile`` discipline.  Bundles are written
+atomically (tmp + ``os.replace``) and the directory is bounded
+(:data:`MAX_BUNDLES`, oldest deleted), so the recorder is safe to leave
+armed in production.  A recorder that breaks the operation it is
+recording is worse than no recorder: every trigger swallows its own
+exceptions.
+
+``python -m dispatches_tpu.obs --flight [--json]`` lists/inspects
+bundles.  Host-side and stdlib-only (no jax import).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dispatches_tpu.analysis.flags import flag_name
+
+__all__ = [
+    "enabled",
+    "enable",
+    "trigger",
+    "bundles",
+    "load_bundle",
+    "reset",
+    "TRIGGER_KINDS",
+    "MAX_BUNDLES",
+    "TAIL_EVENTS",
+]
+
+SCHEMA_VERSION = 1
+MAX_BUNDLES = 64       # directory bound: oldest bundles deleted
+TAIL_EVENTS = 256      # trace-ring tail length per bundle
+
+#: the trigger vocabulary the serve/sweep/runtime hooks use; free-form
+#: kinds are accepted (the recorder is a sink, not a registry)
+TRIGGER_KINDS = (
+    "deadline_miss",
+    "quarantine",
+    "refine_failed",
+    "nan_guard",
+    "solver_nonconverged",
+)
+
+_lock = threading.Lock()
+_seq = itertools.count(1)
+_DIR_OVERRIDE: Optional[str] = None
+_last_snapshot: Optional[Dict] = None
+
+
+def _dir() -> str:
+    if _DIR_OVERRIDE is not None:
+        return _DIR_OVERRIDE
+    return os.environ.get(flag_name("OBS_FLIGHT_DIR"), "")
+
+
+def enabled() -> bool:
+    """Whether the recorder is armed (a bundle directory is configured).
+    Read per call — the hooks are on cold failure paths, not per-lane
+    hot loops, so there is nothing to cache."""
+    return bool(_dir())
+
+
+def enable(directory: Optional[str]) -> None:
+    """Arm the recorder at ``directory`` for this process (tests,
+    embedding drivers); ``enable(None)`` restores the env-flag
+    behaviour, ``enable("")`` force-disarms."""
+    global _DIR_OVERRIDE
+    _DIR_OVERRIDE = directory if directory is None else str(directory)
+
+
+def reset() -> None:
+    """Forget the override and the last-snapshot diff baseline."""
+    global _DIR_OVERRIDE, _last_snapshot
+    with _lock:
+        _DIR_OVERRIDE = None
+        _last_snapshot = None
+
+
+def trigger(kind: str, *, request_id: Optional[int] = None,
+            bucket: Optional[str] = None, label: Optional[str] = None,
+            params_fingerprint: Optional[str] = None,
+            solver_options: Optional[Dict] = None,
+            detail: Optional[Dict] = None,
+            convergence_tail: Optional[List[Dict]] = None) -> Optional[str]:
+    """Record one diagnostic bundle; returns its path (None when the
+    recorder is disarmed or the write failed — triggering never raises).
+
+    ``label`` is the kernel/cost-card label (e.g. ``serve.pdlp#0``);
+    ``convergence_tail`` is the last rows of a decoded solverlog
+    :class:`~dispatches_tpu.obs.solverlog.ConvergenceTrace` when the
+    caller has one (``ConvergenceTrace.tail()``).
+    """
+    directory = _dir()
+    if not directory:
+        return None
+    try:
+        return _write_bundle(
+            directory, kind, request_id=request_id, bucket=bucket,
+            label=label, params_fingerprint=params_fingerprint,
+            solver_options=solver_options, detail=detail,
+            convergence_tail=convergence_tail)
+    except Exception:
+        return None
+
+
+def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
+                  params_fingerprint, solver_options, detail,
+                  convergence_tail) -> str:
+    global _last_snapshot
+    from dispatches_tpu.obs import registry as _registry
+    from dispatches_tpu.obs import trace as _trace
+
+    os.makedirs(directory, exist_ok=True)
+    snapshot = _registry.default_registry().snapshot()
+    with _lock:
+        baseline = _last_snapshot if _last_snapshot is not None else {}
+        diff = _registry.diff_snapshots(baseline, snapshot)
+        _last_snapshot = snapshot
+        seq = next(_seq)
+    tail = _trace.to_chrome_events(_trace.events()[-TAIL_EVENTS:])
+    cost_card = None
+    if label is not None:
+        try:
+            from dispatches_tpu.obs import profile as _profile
+
+            cards = _profile.cards_for(str(label))
+            if cards:
+                cost_card = cards[-1]
+        except Exception:
+            pass
+    bundle = {
+        "schema": SCHEMA_VERSION,
+        "kind": str(kind),
+        "ts_unix": time.time(),
+        "pid": os.getpid(),
+        "trigger": {
+            "request_id": request_id,
+            "bucket": bucket,
+            "label": label,
+            "params_fingerprint": params_fingerprint,
+            "solver_options": solver_options,
+            "detail": detail,
+        },
+        "trace_tail": tail,
+        "trace_dropped": _trace.dropped(),
+        "metrics": snapshot,
+        "metrics_diff": diff,
+        "cost_card": cost_card,
+        "convergence_tail": convergence_tail,
+    }
+    name = f"flight-{time.time_ns():020d}-{seq:04d}-{kind}.json"
+    path = os.path.join(directory, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f, default=str)
+    os.replace(tmp, path)  # atomic: readers never see a torn bundle
+    _prune(directory)
+    try:
+        from dispatches_tpu.obs import trace as _t
+
+        _t.instant("flight.trigger", kind=str(kind),
+                   request_id=request_id, bucket=bucket)
+    except Exception:
+        pass
+    return path
+
+
+def _bundle_paths(directory: str) -> List[str]:
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith("flight-") and n.endswith(".json")]
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in sorted(names)]
+
+
+def _prune(directory: str, keep: Optional[int] = None) -> None:
+    keep = MAX_BUNDLES if keep is None else keep  # read at call time
+    paths = _bundle_paths(directory)
+    for p in paths[:max(0, len(paths) - keep)]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def load_bundle(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def bundles(directory: Optional[str] = None,
+            full: bool = False) -> List[Dict]:
+    """Bundle listing (oldest first) for the CLI: per-bundle header
+    ``{path, kind, ts_unix, request_id, bucket}``; ``full=True``
+    returns the entire bundle contents under the same keys."""
+    directory = directory if directory is not None else _dir()
+    if not directory:
+        return []
+    out: List[Dict] = []
+    for p in _bundle_paths(directory):
+        try:
+            b = load_bundle(p)
+        except Exception:
+            continue
+        if full:
+            b["path"] = p
+            out.append(b)
+        else:
+            out.append({
+                "path": p,
+                "kind": b.get("kind"),
+                "ts_unix": b.get("ts_unix"),
+                "request_id": (b.get("trigger") or {}).get("request_id"),
+                "bucket": (b.get("trigger") or {}).get("bucket"),
+            })
+    return out

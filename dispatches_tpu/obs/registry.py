@@ -192,6 +192,7 @@ class _Window:
         if self.items:
             out["mean"] = round(self.total / max(self.count, 1), 3)
             out["p50"] = round(self.quantile(0.50), 3)
+            out["p95"] = round(self.quantile(0.95), 3)
             out["p99"] = round(self.quantile(0.99), 3)
         return out
 

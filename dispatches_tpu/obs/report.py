@@ -15,6 +15,8 @@ __all__ = [
     "aggregate_spans",
     "format_report",
     "load_chrome_trace",
+    "validate_chrome_trace",
+    "request_journey",
 ]
 
 
@@ -85,6 +87,59 @@ def format_report(events: List[Dict],
                 series = f"{name}{{{label}}}" if label else name
                 lines.append(f"  {series} = {val}")
     return "\n".join(lines) + "\n"
+
+
+def validate_chrome_trace(events: List[Dict]) -> List[str]:
+    """Perfetto-loadability problems in a trace-event list (empty =
+    valid).  Checks the invariants the exporter promises: required keys
+    per phase, non-negative timestamps sorted per ``tid``, and —
+    should a producer ever emit duration-begin events — every ``B``
+    closed by a matching ``E`` on its tid."""
+    problems: List[str] = []
+    last_ts: Dict = {}
+    open_b: Dict = {}
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        if ph is None:
+            problems.append(f"event {i}: missing 'ph'")
+            continue
+        required = ("name", "pid", "tid", "ts") if ph != "E" else (
+            "pid", "tid", "ts")
+        for k in required:
+            if k not in e:
+                problems.append(f"event {i} ({ph}): missing {k!r}")
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"event {i}: bad ts {ts!r}")
+            continue
+        tid = e.get("tid")
+        if tid in last_ts and ts < last_ts[tid]:
+            problems.append(
+                f"event {i}: ts {ts} < previous {last_ts[tid]} on tid {tid}")
+        last_ts[tid] = ts
+        if ph == "X" and not isinstance(e.get("dur"), (int, float)):
+            problems.append(f"event {i}: X event missing numeric 'dur'")
+        elif ph == "B":
+            open_b.setdefault(tid, []).append(e.get("name"))
+        elif ph == "E":
+            if not open_b.get(tid):
+                problems.append(f"event {i}: E with no open B on tid {tid}")
+            else:
+                open_b[tid].pop()
+    for tid, names in open_b.items():
+        for name in names:
+            problems.append(f"unclosed B event {name!r} on tid {tid}")
+    return problems
+
+
+def request_journey(events: List[Dict], request_id: int) -> List[Dict]:
+    """The span events carrying ``args.request_id == request_id``
+    (``serve.request`` / ``serve.queue_wait`` / ``serve.dispatch``),
+    ts-sorted — one request's journey out of a full trace."""
+    out = [e for e in events
+           if (e.get("args") or {}).get("request_id") == request_id]
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("name", "")))
+    return out
 
 
 def load_chrome_trace(path) -> List[Dict]:

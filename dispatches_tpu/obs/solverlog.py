@@ -52,6 +52,24 @@ class ConvergenceTrace:
     def __getitem__(self, name: str) -> np.ndarray:
         return self.columns[name]
 
+    def tail(self, n: int = 8) -> List[Dict[str, float]]:
+        """Last ``n`` recorded rows as plain dicts (JSON-serializable) —
+        the shape ``obs.flight.trigger(convergence_tail=...)`` expects
+        in a diagnostic bundle."""
+        names = list(self.columns)
+        rows = len(next(iter(self.columns.values()))) if names else 0
+        out: List[Dict[str, float]] = []
+        for i in range(max(0, rows - n), rows):
+            row: Dict[str, float] = {"row": i}
+            for name in names:
+                v = self.columns[name][i]
+                if np.issubdtype(np.asarray(v).dtype, np.integer):
+                    row[name] = int(v)
+                else:
+                    row[name] = float(v)
+            out.append(row)
+        return out
+
     def format(self, every: int = 1) -> str:
         """Fixed-width iteration table (one row per recorded step)."""
         names = list(self.columns)

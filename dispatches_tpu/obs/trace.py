@@ -38,6 +38,8 @@ __all__ = [
     "enable",
     "span",
     "instant",
+    "complete",
+    "now_us",
     "events",
     "dropped",
     "reset",
@@ -115,6 +117,13 @@ def _now_us() -> float:
     return time.perf_counter_ns() / 1e3
 
 
+def now_us() -> float:
+    """The tracer's clock (µs, ``perf_counter`` epoch) — callers that
+    emit retroactive :func:`complete` events capture their own
+    timestamps with this so they land on the same axis as live spans."""
+    return _now_us()
+
+
 class Span:
     """One live span; use via ``with span("name", key=val) as sp:``."""
 
@@ -148,6 +157,10 @@ class Span:
         args = dict(self.args)
         if parent is not None:
             args["parent"] = parent
+        if exc_type is not None:
+            # failed spans must be distinguishable in the export; the
+            # exception itself keeps propagating (return False below)
+            args["error"] = exc_type.__name__
         _record({
             "name": self.name,
             "ph": "X",
@@ -209,6 +222,26 @@ def instant(name: str, **args) -> None:
     })
 
 
+def complete(name: str, ts_us: float, dur_us: float, **args) -> None:
+    """Retroactive complete (``ph:X``) event with explicit timestamps.
+
+    The serve layer uses this for per-request journey spans
+    (``serve.request`` / ``serve.queue_wait`` / ``serve.dispatch``):
+    a request's begin time is only known to be interesting once the
+    request completes, so the span is recorded after the fact from
+    timestamps captured with :func:`now_us`."""
+    if not enabled():
+        return
+    _record({
+        "name": name,
+        "ph": "X",
+        "ts": float(ts_us),
+        "dur": max(float(dur_us), 0.0),
+        "tid": threading.get_ident(),
+        "args": args,
+    })
+
+
 def events() -> List[Dict]:
     """Snapshot of the ring buffer (oldest first)."""
     if _BUFFER is None:
@@ -240,6 +273,10 @@ def to_chrome_events(evts: Optional[List[Dict]] = None) -> List[Dict]:
         ce["pid"] = pid
         ce["cat"] = "dispatches_tpu"
         out.append(ce)
+    # ring order is completion order (a parent span lands after its
+    # children, retroactive request spans after the batch) — sort per
+    # (tid, ts) so Perfetto sees monotone timestamps on every track
+    out.sort(key=lambda e: (e.get("tid", 0), e.get("ts", 0.0)))
     return out
 
 

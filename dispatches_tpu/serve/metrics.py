@@ -21,23 +21,45 @@ from typing import Dict, List, Optional
 from dispatches_tpu.obs.registry import Counter, Histogram
 
 
-class LatencyWindow(Histogram):
-    """Sliding window of request latencies (ms) with cheap quantiles.
+class _BucketedWindow(Histogram):
+    """Shared shape of the serve windows: one labeled series per bucket
+    plus the unlabeled aggregate, with the serve layer's historical
+    ``_ms``-suffixed summary keys (p95 added for the SLO layer)."""
 
-    A single-series (unlabeled) histogram with the serve layer's
-    historical ``_ms``-suffixed summary keys."""
+    def __init__(self, name: str, help: str, maxlen: int):
+        super().__init__(name, help, window=maxlen)
+        with self._lock:
+            self._w0 = self._window({})
+        # bound per-bucket cells, resolved once (hot path: per request)
+        self._cells: Dict[str, object] = {}
+
+    def record(self, bucket_label: str, value_ms: float) -> None:
+        cell = self._cells.get(bucket_label)
+        if cell is None:
+            cell = self._cells[bucket_label] = self.labeled(
+                bucket=bucket_label)
+        with self._lock:
+            self._w0.observe(float(value_ms))
+        cell.observe(value_ms)
+
+    def summary_ms(self, **labels) -> Dict[str, float]:
+        s = Histogram.summary(self, **labels)
+        out = {"count": s["count"]}
+        if "mean" in s:
+            out["mean_ms"] = s["mean"]
+            out["p50_ms"] = s["p50"]
+            out["p95_ms"] = s["p95"]
+            out["p99_ms"] = s["p99"]
+        return out
+
+
+class LatencyWindow(_BucketedWindow):
+    """Sliding window of end-to-end request latencies (submit→result,
+    ms), per bucket and aggregate."""
 
     def __init__(self, maxlen: int = 4096):
         super().__init__("serve.latency_ms", "per-request solve latency",
-                         window=maxlen)
-        # single-series histogram: bind the unlabeled window once so the
-        # per-request record() skips label resolution
-        with self._lock:
-            self._w0 = self._window({})
-
-    def record(self, latency_ms: float) -> None:
-        with self._lock:
-            self._w0.observe(float(latency_ms))
+                         maxlen)
 
     @property
     def count(self) -> int:  # was a plain attribute pre-rebase
@@ -48,48 +70,18 @@ class LatencyWindow(Histogram):
         return Histogram.total(self)
 
     def summary(self) -> Dict[str, float]:
-        s = Histogram.summary(self)
-        out = {"count": s["count"]}
-        if "mean" in s:
-            out["mean_ms"] = s["mean"]
-            out["p50_ms"] = s["p50"]
-            out["p99_ms"] = s["p99"]
-        return out
+        return self.summary_ms()
 
 
-class QueueWaitWindow(Histogram):
-    """Sliding window of queue waits (submit→dispatch, ms): one labeled
-    series per bucket plus the unlabeled aggregate, so backpressure is
-    attributable to a bucket and still summarizable service-wide.
-    Distinct from :class:`LatencyWindow` (submit→result): the gap
-    between the two is solve time."""
+class QueueWaitWindow(_BucketedWindow):
+    """Sliding window of queue waits (submit→dispatch, ms), per bucket
+    and aggregate.  Distinct from :class:`LatencyWindow`
+    (submit→result): the gap between the two is solve time."""
 
     def __init__(self, maxlen: int = 4096):
         super().__init__("serve.queue_wait_ms",
                          "request queue wait (submit -> dispatch)",
-                         window=maxlen)
-        with self._lock:
-            self._w0 = self._window({})
-        # bound per-bucket cells, resolved once (hot path: per request)
-        self._cells: Dict[str, object] = {}
-
-    def record(self, bucket_label: str, wait_ms: float) -> None:
-        cell = self._cells.get(bucket_label)
-        if cell is None:
-            cell = self._cells[bucket_label] = self.labeled(
-                bucket=bucket_label)
-        with self._lock:
-            self._w0.observe(float(wait_ms))
-        cell.observe(wait_ms)
-
-    def summary_ms(self, **labels) -> Dict[str, float]:
-        s = Histogram.summary(self, **labels)
-        out = {"count": s["count"]}
-        if "mean" in s:
-            out["mean_ms"] = s["mean"]
-            out["p50_ms"] = s["p50"]
-            out["p99_ms"] = s["p99"]
-        return out
+                         maxlen)
 
 
 class BucketStats:
@@ -189,14 +181,20 @@ def format_stats(metrics: Dict) -> str:
     lat = metrics["latency"]
     if lat.get("count"):
         lines.append(
-            "latency: mean {mean_ms} ms, p50 {p50_ms} ms, p99 {p99_ms} ms "
-            "over {count} request(s)".format(**lat)
+            "latency: mean {mean_ms} ms, p50 {p50_ms} ms, p95 {p95_ms} ms, "
+            "p99 {p99_ms} ms over {count} request(s)".format(**lat)
         )
     qw = metrics.get("queue_wait") or {}
     if qw.get("count"):
         lines.append(
             "queue wait: mean {mean_ms} ms, p50 {p50_ms} ms, "
-            "p99 {p99_ms} ms over {count} request(s)".format(**qw)
+            "p95 {p95_ms} ms, p99 {p99_ms} ms over {count} request(s)".format(**qw)
+        )
+    dl = metrics.get("deadline") or {}
+    if dl.get("requests"):
+        lines.append(
+            "deadlines: {requests} request(s) with deadline, "
+            "{missed} missed (miss rate {miss_rate:.4f})".format(**dl)
         )
     ws = metrics["warm_start"]
     lines.append(
@@ -213,6 +211,16 @@ def format_stats(metrics: Dict) -> str:
                 f"@ lanes {b['lane_counts']}, occupancy {occ}, "
                 f"{b['timeouts']} timeout(s), {b['compiles']} compile(s)"
             )
+            blat = b.get("latency_ms") or {}
+            bqw = b.get("queue_wait_ms") or {}
+            if blat.get("count"):
+                lines.append(
+                    "    latency p50 {p50_ms} / p95 {p95_ms} / "
+                    "p99 {p99_ms} ms".format(**blat)
+                    + ("; queue wait p50 {p50_ms} / p95 {p95_ms} / "
+                       "p99 {p99_ms} ms".format(**bqw)
+                       if bqw.get("count") else "")
+                )
     cards = metrics.get("cost_cards") or {}
     if cards:  # only with DISPATCHES_TPU_OBS_PROFILE (golden unchanged)
         lines.append("cost cards (latest compile per bucket):")

@@ -37,6 +37,7 @@ never a bad vector.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from collections import OrderedDict, deque
@@ -49,6 +50,7 @@ import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import (
@@ -146,9 +148,11 @@ class SolveHandle:
     blocks by draining the owning bucket (synchronous service)."""
 
     __slots__ = ("_service", "_bucket", "params", "x0", "submitted_at",
-                 "deadline_at", "warm_key", "_result")
+                 "deadline_at", "warm_key", "_result", "request_id",
+                 "_t_submit_us")
 
-    def __init__(self, service, bucket, params, submitted_at, deadline_at):
+    def __init__(self, service, bucket, params, submitted_at, deadline_at,
+                 request_id):
         self._service = service
         self._bucket = bucket
         self.params = params
@@ -157,6 +161,14 @@ class SolveHandle:
         self.deadline_at = deadline_at
         self.warm_key = None
         self._result = None
+        #: monotonic per-service id minted at submit; carried through
+        #: queue -> dispatch -> completion and stamped on the
+        #: serve.request / serve.queue_wait / serve.dispatch trace spans
+        self.request_id = request_id
+        # trace-clock submit timestamp for the retroactive journey
+        # spans (one perf_counter_ns read; the service clock may be a
+        # fake, so it cannot share the trace axis)
+        self._t_submit_us = obs_trace.now_us()
 
     @property
     def bucket_label(self) -> str:
@@ -169,12 +181,26 @@ class SolveHandle:
     def done(self) -> bool:
         return self._result is not None
 
-    def result(self) -> ServeResult:
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        """Drain the owning bucket until this request completes.
+
+        ``timeout`` (seconds, measured on the service's injectable
+        clock) bounds the drain: a handle that is still incomplete when
+        the budget is spent raises ``TimeoutError`` instead of spinning
+        ``_flush_bucket`` forever."""
+        deadline = (None if timeout is None
+                    else self._service._clock() + timeout)
         while self._result is None:
             if self._service._flush_bucket(self._bucket) == 0:
                 raise RuntimeError(
                     "request is neither pending nor completed — was the "
                     "service reset while this handle was outstanding?"
+                )
+            if (deadline is not None and self._result is None
+                    and self._service._clock() >= deadline):
+                raise TimeoutError(
+                    f"request {self.request_id} still pending after "
+                    f"{timeout} s (bucket {self.bucket_label!r})"
                 )
         return self._result
 
@@ -253,6 +279,14 @@ class _Bucket:
             kind = "ipm"
         self.kind = kind
         self.stats = BucketStats(label)
+        # process-registry mirrors of the per-request windows (bound
+        # cells: one observe per request) — this is what obs.slo grades
+        self.obs_latency = obs_registry.histogram(
+            "serve.latency_ms", "per-request solve latency"
+        ).labeled(bucket=label)
+        self.obs_queue_wait = obs_registry.histogram(
+            "serve.queue_wait_ms", "request queue wait (submit -> dispatch)"
+        ).labeled(bucket=label)
         if kind == "ipm":
             # x0 always passed: one compiled signature per lane count
             # whether lanes are cold (default x0) or warm-started
@@ -289,6 +323,9 @@ class SolveService:
         self._solved = 0
         self._timeouts = 0
         self._flushes = 0
+        self._deadline_requests = 0   # submitted with a deadline
+        self._deadline_missed = 0     # TIMEOUT or completed past deadline
+        self._request_seq = itertools.count(1)
         # process-wide mirrors (dispatches_tpu.obs) — the per-service
         # numbers above stay authoritative for format_stats()
         _requests = obs_registry.counter(
@@ -298,6 +335,11 @@ class SolveService:
         self._obs_timeout = _requests.labeled(event="timeout")
         self._obs_batches = obs_registry.counter(
             "serve.batches", "solve-service batches dispatched")
+        _deadline = obs_registry.counter(
+            "serve.deadline", "deadline outcomes for deadline-bearing "
+            "requests (event=met|missed)")
+        self._obs_deadline_met = _deadline.labeled(event="met")
+        self._obs_deadline_missed = _deadline.labeled(event="missed")
 
     # -- bucket resolution -------------------------------------------------
 
@@ -356,7 +398,10 @@ class SolveService:
             if self._flush_oldest() == 0:
                 break  # nothing pending anywhere (max_queue == 0 edge)
         deadline_at = None if deadline_ms is None else now + deadline_ms / 1e3
-        handle = SolveHandle(self, bucket, params, now, deadline_at)
+        handle = SolveHandle(self, bucket, params, now, deadline_at,
+                             next(self._request_seq))
+        if deadline_at is not None:
+            self._deadline_requests += 1
         if bucket.kind == "ipm":
             handle.warm_key = (warm_key if warm_key is not None
                                else (bucket.stats.label,
@@ -449,6 +494,8 @@ class SolveService:
         self._flushes += 1
         requests = [bucket.pending.popleft() for _ in range(n)]
         now = self._clock()
+        tracing = obs_trace.enabled()
+        label = bucket.stats.label
         live: List[SolveHandle] = []
         for r in requests:
             if r.deadline_at is not None and now >= r.deadline_at:
@@ -457,14 +504,33 @@ class SolveService:
                     (now - r.submitted_at) * 1e3))
                 bucket.stats.record_timeout()
                 self._timeouts += 1
+                self._deadline_missed += 1
                 self._obs_timeout.inc()
+                self._obs_deadline_missed.inc()
+                if tracing:
+                    t_us = obs_trace.now_us()
+                    obs_trace.complete(
+                        "serve.request", r._t_submit_us,
+                        t_us - r._t_submit_us, request_id=r.request_id,
+                        bucket=label, status=RequestStatus.TIMEOUT)
+                if obs_flight.enabled():
+                    obs_flight.trigger(
+                        "deadline_miss", request_id=r.request_id,
+                        bucket=label, label=f"serve.{label}",
+                        params_fingerprint=request_fingerprint(r.params),
+                        solver_options={"kind": bucket.kind,
+                                        "precision": bucket.precision},
+                        detail={"status": RequestStatus.TIMEOUT,
+                                "waited_ms": (now - r.submitted_at) * 1e3})
             else:
                 live.append(r)
         if not live:
             return n
+        dispatch_us = obs_trace.now_us() if tracing else 0.0
         for r in live:  # queue wait = submit -> this dispatch instant
-            self._queue_wait.record(bucket.stats.label,
-                                    (now - r.submitted_at) * 1e3)
+            wait_ms = (now - r.submitted_at) * 1e3
+            self._queue_wait.record(label, wait_ms)
+            bucket.obs_queue_wait.observe(wait_ms)
         lanes = pad_lanes(len(live), self.options.max_batch)
         pad = lanes - len(live)
         plist = [r.params for r in live] + [live[-1].params] * pad
@@ -493,17 +559,61 @@ class SolveService:
             # latency must cover device completion
             res = sp.fence(res)
         bucket.stats.record_batch(len(live), lanes)
-        self._obs_batches.inc(bucket=bucket.stats.label)
+        self._obs_batches.inc(bucket=label)
         end = self._clock()
+        end_us = obs_trace.now_us() if tracing else 0.0
         objs = np.asarray(res.obj)
+        flight_on = obs_flight.enabled()
+        conv = None
+        if flight_on:  # non-convergence trigger needs the host mask
+            conv_arr = getattr(res, "converged", None)
+            if conv_arr is not None:
+                conv = np.asarray(conv_arr).reshape(-1)
         for i, r in enumerate(live):
             lane = jax.tree_util.tree_map(lambda a, _i=i: a[_i], res)
             latency = (end - r.submitted_at) * 1e3
             r._complete(ServeResult(
                 RequestStatus.DONE, lane, float(objs[i]), latency))
-            self._latency.record(latency)
+            self._latency.record(label, latency)
+            bucket.obs_latency.observe(latency)
             bucket.stats.record_solved()
             self._solved += 1
+            missed_deadline = (r.deadline_at is not None
+                               and end > r.deadline_at)
+            if r.deadline_at is not None:
+                if missed_deadline:
+                    self._deadline_missed += 1
+                    self._obs_deadline_missed.inc()
+                else:
+                    self._obs_deadline_met.inc()
+            if tracing:
+                obs_trace.complete(
+                    "serve.queue_wait", r._t_submit_us,
+                    dispatch_us - r._t_submit_us,
+                    request_id=r.request_id, bucket=label)
+                obs_trace.complete(
+                    "serve.dispatch", dispatch_us, end_us - dispatch_us,
+                    request_id=r.request_id, bucket=label, lanes=lanes)
+                obs_trace.complete(
+                    "serve.request", r._t_submit_us,
+                    end_us - r._t_submit_us, request_id=r.request_id,
+                    bucket=label, status=RequestStatus.DONE)
+            if flight_on and (missed_deadline
+                              or (conv is not None and i < conv.size
+                                  and not bool(conv[i]))):
+                obs_flight.trigger(
+                    "deadline_miss" if missed_deadline
+                    else "solver_nonconverged",
+                    request_id=r.request_id, bucket=label,
+                    label=f"serve.{label}",
+                    params_fingerprint=request_fingerprint(r.params),
+                    solver_options={"kind": bucket.kind,
+                                    "precision": bucket.precision},
+                    detail={"latency_ms": latency,
+                            "obj": float(objs[i]),
+                            "converged": (None if conv is None
+                                          or i >= conv.size
+                                          else bool(conv[i]))})
             if bucket.kind == "ipm" and self.options.warm_start:
                 self._warm.put(r.warm_key, bucket.nlp, lane)
         self._obs_solved.inc(len(live))
@@ -513,8 +623,13 @@ class SolveService:
 
     def metrics(self) -> Dict:
         """Plain-dict service telemetry (see docs/serve.md)."""
-        buckets = {b.stats.label: b.stats.as_dict(b.compiles)
-                   for b in self._buckets.values()}
+        buckets = {}
+        for b in self._buckets.values():
+            d = b.stats.as_dict(b.compiles)
+            d["latency_ms"] = self._latency.summary_ms(bucket=b.stats.label)
+            d["queue_wait_ms"] = self._queue_wait.summary_ms(
+                bucket=b.stats.label)
+            buckets[b.stats.label] = d
         cost_cards: Dict = {}
         try:  # per-bucket AOT cost cards, present only when profiling
             from dispatches_tpu.obs import profile
@@ -543,6 +658,15 @@ class SolveService:
                             for b in self._buckets.values()),
             "latency": self._latency.summary(),
             "queue_wait": self._queue_wait.summary_ms(),
+            "deadline": {
+                "requests": self._deadline_requests,
+                "missed": self._deadline_missed,
+                # miss rate over ALL submitted traffic (a service with
+                # no deadline-bearing requests reports 0.0) — the
+                # bench/ledger `deadline_miss_rate` metric
+                "miss_rate": (self._deadline_missed / self._submitted
+                              if self._submitted else 0.0),
+            },
             "warm_start": {"hits": self._warm_hits,
                            "misses": self._warm_misses,
                            "size": len(self._warm)},

@@ -41,6 +41,8 @@ import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import pad_lanes, request_fingerprint
 from dispatches_tpu.sweep.spec import SweepSpec
@@ -211,14 +213,27 @@ def run_sweep(nlp, spec: SweepSpec, *,
         t0 = time.perf_counter()
         with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
             obj, conv, iters, refined = solve_chunk(values, n_live)
+            # serve backend: the service request ids of this chunk's
+            # points, so the quarantine path names the same id the
+            # serve.request trace spans carry
+            rids = list(getattr(solve_chunk, "last_request_ids", None)
+                        or [])
             status = np.zeros(n_live, dtype=np.int8)
             retries = np.zeros(n_live, dtype=np.int16)
             for j in np.where(~np.isfinite(obj))[0]:
+                rid = rids[j] if j < len(rids) else None
                 for attempt in range(1, opts.max_retries + 1):
                     single = {k: np.asarray(v)[j:j + 1]
                               for k, v in values.items()}
                     o1, c1, i1, r1 = solve_chunk(single, 1)
+                    retry_rids = getattr(solve_chunk, "last_request_ids",
+                                         None)
+                    if retry_rids:
+                        rid = retry_rids[0]
                     retries[j] = attempt
+                    obs_trace.instant(
+                        "sweep.retry", point=int(idxs[j]),
+                        attempt=attempt, request_id=rid)
                     if np.isfinite(o1[0]):
                         obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
                         refined[j] = r1[0]
@@ -227,6 +242,17 @@ def run_sweep(nlp, spec: SweepSpec, *,
                 else:
                     status[j] = STATUS_QUARANTINED
                     conv[j] = False
+                    obs_trace.instant("sweep.quarantine",
+                                      point=int(idxs[j]), request_id=rid)
+                    if obs_flight.enabled():
+                        obs_flight.trigger(
+                            "quarantine", request_id=rid,
+                            label="sweep." + opts.backend.lower(),
+                            detail={"point": int(idxs[j]),
+                                    "retries": int(retries[j]),
+                                    "obj": (float(obj[j])
+                                            if np.isfinite(obj[j])
+                                            else None)})
             # a finite point that consumed refinement epochs yet still
             # missed tol carries a low-tier-accuracy objective: keep it
             # out of training_data (like non-finite quarantine) but
@@ -235,6 +261,18 @@ def run_sweep(nlp, spec: SweepSpec, *,
             refine_failed = ((status < STATUS_QUARANTINED)
                              & np.isfinite(obj) & ~conv & (refined > 0))
             status[refine_failed] = STATUS_REFINE_FAILED
+            for j in np.where(refine_failed)[0]:
+                rid = rids[j] if j < len(rids) else None
+                obs_trace.instant("sweep.refine_failed",
+                                  point=int(idxs[j]), request_id=rid)
+                if obs_flight.enabled():
+                    obs_flight.trigger(
+                        "refine_failed", request_id=rid,
+                        label="sweep." + opts.backend.lower(),
+                        detail={"point": int(idxs[j]),
+                                "obj": float(obj[j]),
+                                "refined": int(refined[j])})
+            _record_point_outcomes(status)
         store.record_chunk(cid, {
             "index": idxs.astype(np.int64),
             "obj": obj,
@@ -251,6 +289,24 @@ def run_sweep(nlp, spec: SweepSpec, *,
             on_chunk(cid, len(plan))
     _ledger_record(store, opts, solve_chunk)
     return store
+
+
+_STATUS_EVENT = {STATUS_OK: "ok", STATUS_RETRIED: "retried",
+                 STATUS_QUARANTINED: "quarantined",
+                 STATUS_REFINE_FAILED: "refine_failed"}
+
+
+def _record_point_outcomes(status: np.ndarray) -> None:
+    """Mirror one chunk's per-point outcomes into the process registry
+    (``sweep.points`` counter, ``event=`` labels) — the denominator/
+    numerators obs.slo's quarantine / refine-fail objectives grade."""
+    ctr = obs_registry.counter(
+        "sweep.points", "sweep point outcomes (event=ok|retried|"
+        "quarantined|refine_failed)")
+    for code, event in _STATUS_EVENT.items():
+        k = int(np.count_nonzero(status == code))
+        if k:
+            ctr.inc(k, event=event)
 
 
 def _chunk_cost_telemetry(opts: "SweepOptions",
@@ -418,7 +474,13 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                     else:
                         f[k] = np.asarray(arr)[i]
                 plist.append({"p": p, "fixed": f})
-            rs = service.solve_many(nlp, plist, **solver_kw)
+            handles = [service.submit(nlp, p, **solver_kw) for p in plist]
+            service.flush_all()
+            rs = [h.result() for h in handles]
+            # expose the ids for the engine's retry/quarantine
+            # telemetry: the flight bundle for a quarantined point names
+            # the same request_id its serve.request span carries
+            solve_chunk.last_request_ids = [h.request_id for h in handles]
             obj = np.full(n_live, np.nan)
             conv = np.zeros(n_live, dtype=bool)
             iters = np.zeros(n_live, dtype=np.int64)

@@ -36,6 +36,10 @@ def test_preview_record_passes_schema(bench):
         assert key in out
     for key in bench.ROOFLINE_KEYS:
         assert key in out["roofline"]
+    # serve section carries the SLO tail metrics (null on records that
+    # predate them, but the keys are part of the contract)
+    for key in bench.SERVE_KEYS:
+        assert key in out["serve"]
 
 
 def test_preview_pdlp_variant_ab(bench):
@@ -123,6 +127,14 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["pdlp_precision"]
+    bench.validate_bench_output(out)
+    # the serve section must carry the SLO tail keys when present
+    out = json.load(open(PREVIEW))
+    del out["serve"]["serve_p99_ms"]
+    with pytest.raises(ValueError, match="serve_p99_ms"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["serve"]
     bench.validate_bench_output(out)
 
 

@@ -586,7 +586,13 @@ def test_acceptance_double_loop_trace_export(tmp_path):
     part = th[th.Generator == "1_WIND"]
     assert len(part) == 24 and np.all(np.isfinite(part["Dispatch"]))
 
-    # a small serve workload in the same process contributes batch spans
+    # a small serve workload in the same process contributes batch
+    # spans — with the flight recorder armed and one doomed deadline,
+    # so the full observability stack is exercised in one trace
+    from dispatches_tpu.obs import flight
+    from dispatches_tpu.obs.__main__ import main as obs_main
+
+    flight.enable(str(tmp_path / "flight"))
     service = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9))
     nlp = _arbitrage_nlp(4)
     defaults = nlp.default_params()
@@ -600,8 +606,14 @@ def test_acceptance_double_loop_trace_export(tmp_path):
              "fixed": defaults["fixed"]},
             solver="pdlp",
         ))
+    doomed = service.submit(
+        nlp, {"p": {**defaults["p"],
+                    "price": 30.0 + 10.0 * srng.standard_normal(4)},
+              "fixed": defaults["fixed"]},
+        solver="pdlp", deadline_ms=0.0)  # forced miss on dispatch
     service.flush_all()
     assert all(h.result().status == "DONE" for h in hs)
+    assert doomed.result().status == "TIMEOUT"
 
     path = tmp_path / "double_loop_trace.json"
     trace.export_chrome_trace(path)
@@ -610,6 +622,52 @@ def test_acceptance_double_loop_trace_export(tmp_path):
     assert "market.ruc" in names
     assert names.count("market.sced") == 24
     assert "serve.batch" in names
+
+    # ISSUE 8 acceptance (tentpole 1): the export is a valid Chrome
+    # trace and a single request_id links one request's submit ->
+    # dispatch -> completion spans
+    assert report.validate_chrome_trace(evts) == []
+    rid = hs[0].request_id
+    j = report.request_journey(evts, rid)
+    jnames = {e["name"] for e in j}
+    assert {"serve.queue_wait", "serve.dispatch",
+            "serve.request"} <= jnames
+    done = [e for e in j if e["name"] == "serve.request"]
+    assert done and done[0]["args"]["status"] == "DONE"
+    assert all(e["args"]["bucket"] == hs[0].bucket_label for e in j)
+
+    # ISSUE 8 acceptance (tentpole 2): --slo --json on the live
+    # registry reports per-bucket percentiles and the deadline ratio
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = obs_main(["--slo", "--json"])
+    assert rc == 0
+    slo_payload = json.loads(buf.getvalue())
+    lat_rows = [r for r in slo_payload["results"]
+                if r["objective"] == "serve_latency_p99"
+                and not r["no_data"]]
+    assert lat_rows and all(r["series"].startswith("bucket=")
+                            for r in lat_rows)
+    dl_rows = [r for r in slo_payload["results"]
+               if r["objective"] == "deadline_miss_ratio"]
+    assert dl_rows and dl_rows[0]["value"] > 0  # the forced miss counted
+
+    # ISSUE 8 acceptance (tentpole 3): the forced deadline miss dumped
+    # a flight bundle that round-trips through the CLI
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = obs_main(["--flight", "--json",
+                       "--flight-dir", str(tmp_path / "flight")])
+    assert rc == 0
+    bundles = json.loads(buf.getvalue())["bundles"]
+    misses = [b for b in bundles if b["kind"] == "deadline_miss"]
+    assert misses
+    assert misses[0]["trigger"]["request_id"] == doomed.request_id
+    assert misses[0]["trace_tail"]
+    flight.reset()
     compiles = [e for e in evts if e["name"] == "compile" and e["ph"] == "i"]
     assert len(compiles) >= 1
     # PR 5 acceptance: compile instants carry cost cards — every

@@ -473,3 +473,163 @@ def test_cli_stats_smoke(capsys):
     out = capsys.readouterr().out
     assert "dispatches_tpu.serve stats" in out
     assert "compiled programs" in out
+
+
+# ---------------------------------------------------------------------
+# per-request observability: ids, journey spans, deadlines, flight
+# ---------------------------------------------------------------------
+
+
+def test_result_timeout_raises_on_fake_clock(nlp8, monkeypatch):
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=1e9,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(11)
+    h = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                   base_solver=_toy_base_solver)
+
+    # a flush that makes progress but never completes THIS handle:
+    # result(timeout=) must abandon the drain instead of spinning
+    def stuck_flush(bucket):
+        clock.advance(0.4)
+        return 1
+
+    monkeypatch.setattr(svc, "_flush_bucket", stuck_flush)
+    with pytest.raises(TimeoutError, match=r"request \d+ still pending "
+                                           r"after 1.0 s"):
+        h.result(timeout=1.0)
+    # the handle is still pending, not poisoned: a real flush completes it
+    monkeypatch.undo()
+    assert h.result(timeout=10.0).status == RequestStatus.DONE
+
+
+def test_request_ids_thread_through_journey_spans(nlp8):
+    from dispatches_tpu.obs import report as obs_report
+    from dispatches_tpu.obs import trace as obs_trace
+
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(12)
+    obs_trace.enable(True)
+    obs_trace.reset()
+    try:
+        hs = []
+        for _ in range(3):
+            clock.advance(1e-3)
+            hs.append(svc.submit(nlp8, _price_params(nlp8, 8, rng),
+                                 solver="ipm",
+                                 base_solver=_toy_base_solver))
+        svc.flush_all()
+        assert all(h.result().status == RequestStatus.DONE for h in hs)
+        # ids are minted monotonically at submit and survive completion
+        rids = [h.request_id for h in hs]
+        assert rids == sorted(rids) and len(set(rids)) == 3
+        evts = obs_trace.to_chrome_events()
+        assert obs_report.validate_chrome_trace(evts) == []
+        # one request's journey: queue_wait -> dispatch -> request,
+        # every span stamped with the id and the bucket label
+        j = obs_report.request_journey(evts, rids[0])
+        names = {e["name"] for e in j}
+        assert names == {"serve.queue_wait", "serve.dispatch",
+                         "serve.request"}
+        for e in j:
+            assert e["args"]["bucket"] == hs[0].bucket_label
+        req = next(e for e in j if e["name"] == "serve.request")
+        qw = next(e for e in j if e["name"] == "serve.queue_wait")
+        disp = next(e for e in j if e["name"] == "serve.dispatch")
+        assert req["args"]["status"] == RequestStatus.DONE
+        # the sub-spans tile the request span on the trace clock
+        assert qw["ts"] == req["ts"]
+        assert disp["ts"] == pytest.approx(qw["ts"] + qw["dur"])
+        assert (disp["ts"] + disp["dur"]
+                == pytest.approx(req["ts"] + req["dur"]))
+        # the first submit waited longest: its queue-wait span is the
+        # widest of the three (FIFO made visible in the trace)
+        waits = {e["args"]["request_id"]: e["dur"]
+                 for e in evts if e["name"] == "serve.queue_wait"}
+        assert waits[rids[0]] >= waits[rids[1]] >= waits[rids[2]]
+    finally:
+        obs_trace.enable(False)
+        obs_trace.reset()
+
+
+def test_deadline_metrics_and_flight_bundle(nlp8, tmp_path):
+    from dispatches_tpu.obs import flight
+    from dispatches_tpu.obs import trace as obs_trace
+
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=1e9,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(13)
+    obs_trace.enable(True)
+    obs_trace.reset()
+    flight.enable(str(tmp_path))
+    try:
+        doomed = svc.submit(nlp8, _price_params(nlp8, 8, rng),
+                            solver="ipm", base_solver=_toy_base_solver,
+                            deadline_ms=5.0)
+        met = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                         base_solver=_toy_base_solver, deadline_ms=1e6)
+        free = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                          base_solver=_toy_base_solver)
+        clock.advance(0.010)  # past doomed's deadline only
+        svc.flush_all()
+        assert doomed.result().status == RequestStatus.TIMEOUT
+        assert met.result().status == RequestStatus.DONE
+        assert free.result().status == RequestStatus.DONE
+
+        dl = svc.metrics()["deadline"]
+        assert dl["requests"] == 2 and dl["missed"] == 1
+        # miss rate is over ALL submitted traffic (the ledger metric)
+        assert dl["miss_rate"] == pytest.approx(1.0 / 3.0)
+        text = svc.format_stats()
+        assert "deadlines: 2 request(s) with deadline, 1 missed" in text
+
+        # the timed-out request still gets a terminal journey span
+        from dispatches_tpu.obs import report as obs_report
+
+        evts = obs_trace.to_chrome_events()
+        j = obs_report.request_journey(evts, doomed.request_id)
+        req = [e for e in j if e["name"] == "serve.request"]
+        assert req and req[0]["args"]["status"] == RequestStatus.TIMEOUT
+
+        # the miss produced exactly one flight bundle, tied to the id
+        found = flight.bundles(str(tmp_path))
+        assert [b["kind"] for b in found] == ["deadline_miss"]
+        b = flight.load_bundle(found[0]["path"])
+        assert b["trigger"]["request_id"] == doomed.request_id
+        assert b["trigger"]["bucket"] == doomed.bucket_label
+        assert b["trigger"]["solver_options"]["kind"] == "ipm"
+        assert b["trigger"]["params_fingerprint"]
+        assert b["trigger"]["detail"]["status"] == RequestStatus.TIMEOUT
+    finally:
+        flight.reset()
+        obs_trace.enable(False)
+        obs_trace.reset()
+
+
+def test_flight_off_serve_deadline_path_untouched(nlp8, monkeypatch):
+    """Acceptance: recorder disarmed => the serve hot path never even
+    assembles trigger context — ``flight.trigger`` is spy-pinned to
+    zero calls across a deadline miss (the obs.profile discipline)."""
+    from dispatches_tpu.obs import flight
+
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", raising=False)
+    flight.reset()
+    calls = []
+    monkeypatch.setattr(flight, "trigger",
+                        lambda *a, **k: calls.append(a) or None)
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=1e9,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(14)
+    doomed = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                        base_solver=_toy_base_solver, deadline_ms=5.0)
+    live = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                      base_solver=_toy_base_solver)
+    clock.advance(0.010)
+    svc.flush_all()
+    assert doomed.result().status == RequestStatus.TIMEOUT
+    assert live.result().status == RequestStatus.DONE
+    assert calls == []  # never called: enabled() guards every hook

@@ -1,0 +1,367 @@
+"""SLO accounting + flight recorder (obs.slo / obs.flight) and the
+per-request tracing primitives they ride on: retroactive complete
+events, error-tagged spans, Chrome-trace validation, request journeys,
+spec loading/grading, the ``--slo`` / ``--flight`` CLI, and the
+bounded atomic bundle store.  Everything here is host-side (no XLA
+compiles) — the tier-1 budget has zero headroom for new programs; the
+end-to-end serve/sweep integrations live in test_serve.py /
+test_sweep.py and the slow-lane acceptance test.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.obs import flight, report, slo, trace
+from dispatches_tpu.obs import registry as reg
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE_SPEC = os.path.join(REPO_ROOT, "examples", "slo_spec.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    trace.enable(False)
+    trace.reset()
+    flight.reset()
+    yield
+    trace.enable(False)
+    trace.reset()
+    flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# tracing primitives
+# ---------------------------------------------------------------------------
+
+
+def test_span_error_exit_records_exception_type():
+    trace.enable(True)
+    with pytest.raises(ValueError):
+        with trace.span("doomed", tag="x"):
+            raise ValueError("boom")
+    with trace.span("fine"):
+        pass
+    evts = trace.events()
+    doomed = next(e for e in evts if e["name"] == "doomed")
+    fine = next(e for e in evts if e["name"] == "fine")
+    # the failed span is marked but still a complete event (the
+    # exception propagated — the context manager must not swallow it)
+    assert doomed["args"]["error"] == "ValueError"
+    assert doomed["args"]["tag"] == "x"
+    assert doomed["ph"] == "X" and doomed["dur"] >= 0
+    assert "error" not in fine["args"]
+
+
+def test_complete_records_retroactive_span():
+    trace.enable(True)
+    t0 = trace.now_us()
+    trace.complete("retro", t0, 125.0, request_id=7, bucket="b#0")
+    trace.complete("clamped", t0, -5.0)  # negative dur clamps to 0
+    evts = trace.events()
+    retro = evts[0]
+    assert retro["ph"] == "X" and retro["ts"] == t0 and retro["dur"] == 125.0
+    assert retro["args"] == {"request_id": 7, "bucket": "b#0"}
+    assert evts[1]["dur"] == 0.0
+    # disabled: no event, no error
+    trace.enable(False)
+    trace.complete("dropped", t0, 1.0)
+    assert len(trace.events()) == 2
+
+
+def test_chrome_events_sorted_per_tid_after_retroactive_emits():
+    trace.enable(True)
+    t0 = trace.now_us()
+    with trace.span("batch"):
+        pass
+    # journey spans are recorded AFTER the batch span but start earlier
+    trace.complete("request", t0, 10.0, request_id=1)
+    out = trace.to_chrome_events()
+    assert report.validate_chrome_trace(out) == []
+    assert [e["name"] for e in out] == ["request", "batch"]
+
+
+def test_validate_chrome_trace_flags_problems():
+    ok = [{"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1,
+           "tid": 1},
+          {"name": "b", "ph": "i", "ts": 2.0, "pid": 1, "tid": 1, "s": "t"}]
+    assert report.validate_chrome_trace(ok) == []
+    bad = [{"ph": "X", "ts": -1.0, "pid": 1, "tid": 1},           # neg ts
+           {"name": "x", "ph": "X", "ts": 5.0, "pid": 1, "tid": 2},  # no dur
+           {"name": "y", "ph": "i", "ts": 1.0, "pid": 1, "tid": 2},  # ts drop
+           {"name": "z", "ph": "B", "ts": 2.0, "pid": 1, "tid": 2},  # no E
+           {"ph": "E", "ts": 3.0, "pid": 1, "tid": 9}]           # E w/o B
+    problems = report.validate_chrome_trace(bad)
+    assert any("missing 'name'" in p for p in problems)
+    assert any("bad ts" in p for p in problems)
+    assert any("missing numeric 'dur'" in p for p in problems)
+    assert any("< previous" in p for p in problems)
+    assert any("unclosed B" in p for p in problems)
+    assert any("E with no open B" in p for p in problems)
+
+
+def test_request_journey_filters_and_sorts():
+    evts = [
+        {"name": "serve.dispatch", "ts": 5.0,
+         "args": {"request_id": 1, "bucket": "b"}},
+        {"name": "serve.request", "ts": 1.0, "args": {"request_id": 1}},
+        {"name": "serve.request", "ts": 2.0, "args": {"request_id": 2}},
+        {"name": "unrelated", "ts": 0.0, "args": {}},
+        {"name": "noargs", "ts": 0.0},
+    ]
+    j = report.request_journey(evts, 1)
+    assert [e["name"] for e in j] == ["serve.request", "serve.dispatch"]
+    assert report.request_journey(evts, 99) == []
+
+
+# ---------------------------------------------------------------------------
+# SLO spec + evaluation
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_with(latency_by_bucket, deadline=None, submitted=None):
+    """Hand-built registry-snapshot shape (what snapshot() emits)."""
+    snap = {
+        "serve.latency_ms": {
+            "kind": "histogram",
+            "values": {f"bucket={b}": {"count": 10, "mean": v, "p50": v,
+                                       "p95": v, "p99": v}
+                       for b, v in latency_by_bucket.items()},
+        },
+    }
+    if deadline is not None:
+        snap["serve.deadline"] = {"kind": "counter", "values": deadline}
+    if submitted is not None:
+        snap["serve.requests"] = {"kind": "counter", "values": submitted}
+    return snap
+
+
+def test_slo_quantile_group_by_fans_out_per_bucket():
+    spec = slo.spec_from_dict({"name": "t", "objectives": [
+        {"name": "lat", "kind": "quantile", "metric": "serve.latency_ms",
+         "p": "p99", "target": 100.0, "group_by": "bucket"}]})
+    rows = slo.evaluate(spec, _snapshot_with({"a#0": 50.0, "b#0": 250.0}))
+    assert len(rows) == 2
+    by_series = {r["series"]: r for r in rows}
+    assert by_series["bucket=a#0"]["ok"] is True
+    assert by_series["bucket=a#0"]["burn"] == 0.5
+    assert by_series["bucket=b#0"]["ok"] is False
+    assert by_series["bucket=b#0"]["burn"] == 2.5
+    assert [r["objective"] for r in slo.violations(rows)] == ["lat"]
+
+
+def test_slo_ratio_and_no_data_soft_pass():
+    spec = slo.spec_from_dict({"name": "t", "objectives": [
+        {"name": "miss", "kind": "ratio", "target": 0.01,
+         "num": {"metric": "serve.deadline", "labels": {"event": "missed"}},
+         "den": {"metric": "serve.requests",
+                 "labels": {"event": "submitted"}}}]})
+    # 2 missed / 10 submitted = 0.2 >> 0.01 -> violation, burn 20
+    rows = slo.evaluate(spec, _snapshot_with(
+        {}, deadline={"event=missed": 2, "event=met": 3},
+        submitted={"event=submitted": 10, "event=timeout": 1}))
+    assert rows[0]["ok"] is False and rows[0]["burn"] == 20.0
+    # zero denominator -> no_data, never a violation
+    rows = slo.evaluate(spec, _snapshot_with({}))
+    assert rows[0]["no_data"] is True and rows[0]["ok"] is None
+    assert slo.violations(rows) == []
+    # a numerator with no matching series counts as 0, not no-data
+    rows = slo.evaluate(spec, _snapshot_with(
+        {}, deadline={"event=met": 3}, submitted={"event=submitted": 10}))
+    assert rows[0]["value"] == 0.0 and rows[0]["ok"] is True
+
+
+def test_slo_spec_validation_errors():
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        slo.SLOObjective(name="x", kind="median", target=1.0)
+    with pytest.raises(ValueError, match="needs 'metric'"):
+        slo.SLOObjective(name="x", kind="quantile", target=1.0)
+    with pytest.raises(ValueError, match="p must be one of"):
+        slo.SLOObjective(name="x", kind="quantile", target=1.0,
+                         metric="m", p="p42")
+    with pytest.raises(ValueError, match="needs num.metric"):
+        slo.SLOObjective(name="x", kind="ratio", target=1.0)
+
+
+def test_slo_load_committed_example_spec(monkeypatch):
+    spec = slo.load_spec(EXAMPLE_SPEC)
+    assert len(spec.objectives) == 5
+    names = [o.name for o in spec.objectives]
+    assert "serve_latency_p99" in names and "deadline_miss_ratio" in names
+    # the committed example mirrors the built-in objectives
+    built = slo.builtin_spec()
+    assert names == [o.name for o in built.objectives]
+    # default resolution: env flag, then builtin
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_SLO", EXAMPLE_SPEC)
+    assert slo.load_spec().name == "example"
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_SLO")
+    assert slo.load_spec().name == "builtin"
+
+
+def test_slo_format_results_renders_attainment():
+    spec = slo.spec_from_dict({"name": "t", "objectives": [
+        {"name": "lat", "kind": "quantile", "metric": "serve.latency_ms",
+         "p": "p99", "target": 100.0, "group_by": "bucket"},
+        {"name": "ghost", "kind": "quantile", "metric": "absent",
+         "target": 1.0}]})
+    rows = slo.evaluate(spec, _snapshot_with({"a#0": 250.0}))
+    text = slo.format_results(spec, rows)
+    assert "== SLO report · spec 't' ==" in text
+    assert "VIOL lat [bucket=a#0]: 250 vs target 100 (burn 2.50)" in text
+    assert "ghost: no data" in text
+    assert "1 violation(s), 1 no-data objective(s), 2 series graded" in text
+
+
+def test_slo_cli_check_exit_codes(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    snap_ok = _snapshot_with({"a#0": 5.0},
+                             deadline={"event=met": 5},
+                             submitted={"event=submitted": 5})
+    snap_bad = _snapshot_with({"a#0": 5.0},
+                              deadline={"event=missed": 5},
+                              submitted={"event=submitted": 5})
+    ok_file, bad_file = tmp_path / "ok.json", tmp_path / "bad.json"
+    ok_file.write_text(json.dumps(snap_ok))
+    bad_file.write_text(json.dumps(snap_bad))
+
+    rc = main(["--slo", "--json", "--slo-spec", EXAMPLE_SPEC,
+               "--metrics-file", str(ok_file)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+    assert payload["spec"] == "example"
+    lat = [r for r in payload["results"]
+           if r["objective"] == "serve_latency_p99"]
+    assert lat and lat[0]["series"] == "bucket=a#0"
+
+    # violation without --check still exits 0 (report, don't gate)
+    rc = main(["--slo", "--slo-spec", EXAMPLE_SPEC,
+               "--metrics-file", str(bad_file)])
+    assert rc == 0 and "VIOL" in capsys.readouterr().out
+    # --check turns the violation into a non-zero exit
+    rc = main(["--slo", "--check", "--slo-spec", EXAMPLE_SPEC,
+               "--metrics-file", str(bad_file)])
+    assert rc == 1 and "deadline_miss_ratio" in capsys.readouterr().out
+    # and a clean snapshot passes the gate
+    rc = main(["--slo", "--check", "--slo-spec", EXAMPLE_SPEC,
+               "--metrics-file", str(ok_file)])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_disarmed_is_default_and_writes_nothing(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", raising=False)
+    assert not flight.enabled()
+    assert flight.trigger("deadline_miss", request_id=1) is None
+    assert list(tmp_path.iterdir()) == []
+    # arming via env works like the other obs flags
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+    assert flight.enabled()
+    # enable("") force-disarms over the env
+    flight.enable("")
+    assert not flight.enabled()
+
+
+def test_flight_bundle_round_trip_with_trace_and_metrics(tmp_path):
+    trace.enable(True)
+    flight.enable(str(tmp_path))
+    c = reg.counter("flight.test.events")
+    c.inc(3, event="x")
+    with trace.span("solve.batch", bucket="pdlp#0"):
+        pass
+    path = flight.trigger(
+        "deadline_miss", request_id=42, bucket="pdlp#0",
+        label="serve.pdlp#0", params_fingerprint="abc123",
+        solver_options={"kind": "pdlp"},
+        detail={"waited_ms": 12.5},
+        convergence_tail=[{"row": 0, "gap": 1e-3}])
+    assert path is not None and os.path.exists(path)
+    b = flight.load_bundle(path)
+    assert b["schema"] == flight.SCHEMA_VERSION
+    assert b["kind"] == "deadline_miss"
+    assert b["trigger"]["request_id"] == 42
+    assert b["trigger"]["params_fingerprint"] == "abc123"
+    assert b["trigger"]["detail"] == {"waited_ms": 12.5}
+    assert b["convergence_tail"] == [{"row": 0, "gap": 1e-3}]
+    assert "flight.test.events" in b["metrics"]
+    names = [e["name"] for e in b["trace_tail"]]
+    assert "solve.batch" in names
+    assert report.validate_chrome_trace(b["trace_tail"]) == []
+    # a second trigger diffs against the first bundle's snapshot
+    c.inc(2, event="x")
+    b2 = flight.load_bundle(flight.trigger("nan_guard"))
+    assert b2["metrics_diff"]["flight.test.events"]["delta"] == {
+        "event=x": 2}
+    # the write emits a trace instant carrying the request id, so the
+    # anomaly shows up in the request's own journey
+    insts = [e for e in trace.events() if e["name"] == "flight.trigger"]
+    assert insts and insts[0]["args"]["request_id"] == 42
+
+
+def test_flight_directory_is_bounded(tmp_path, monkeypatch):
+    flight.enable(str(tmp_path))
+    monkeypatch.setattr(flight, "MAX_BUNDLES", 5)
+    for i in range(8):
+        assert flight.trigger("quarantine", request_id=i) is not None
+    found = flight.bundles(str(tmp_path))
+    assert len(found) == 5
+    # oldest pruned: the survivors are the last five triggers
+    assert [b["request_id"] for b in found] == [3, 4, 5, 6, 7]
+    assert all(b["kind"] == "quarantine" for b in found)
+
+
+def test_flight_trigger_never_raises(tmp_path, monkeypatch):
+    flight.enable(str(tmp_path))
+
+    def explode(*a, **k):
+        raise RuntimeError("disk on fire")
+
+    monkeypatch.setattr(flight, "_write_bundle", explode)
+    assert flight.trigger("nan_guard") is None  # swallowed, not raised
+
+
+def test_flight_cli_lists_and_dumps(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    flight.enable(str(tmp_path))
+    flight.trigger("deadline_miss", request_id=7, bucket="pdlp#0")
+    rc = main(["--flight", "--flight-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "deadline_miss request_id=7 bucket=pdlp#0" in out
+    rc = main(["--flight", "--json", "--flight-dir", str(tmp_path)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and len(payload["bundles"]) == 1
+    b = payload["bundles"][0]
+    assert b["kind"] == "deadline_miss"
+    assert b["trigger"]["request_id"] == 7
+    # empty directory: friendly hint, rc 0
+    rc = main(["--flight", "--flight-dir", str(tmp_path / "empty")])
+    assert rc == 0
+    assert "no flight bundles" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# sweep outcome counters (unit level — the run_sweep integration rides
+# in test_sweep.py's existing quarantine run)
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_point_outcome_counter():
+    from dispatches_tpu.sweep.engine import _record_point_outcomes
+
+    ctr = reg.counter("sweep.points")
+    before = {ev: ctr.value(event=ev)
+              for ev in ("ok", "retried", "quarantined", "refine_failed")}
+    _record_point_outcomes(np.array([0, 0, 1, 2, 3, 0], dtype=np.int8))
+    assert ctr.value(event="ok") - before["ok"] == 3
+    assert ctr.value(event="retried") - before["retried"] == 1
+    assert ctr.value(event="quarantined") - before["quarantined"] == 1
+    assert ctr.value(event="refine_failed") - before["refine_failed"] == 1

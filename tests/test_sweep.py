@@ -314,15 +314,44 @@ def _poisoned_solver(params):
 
 
 def test_nonfinite_points_quarantined_not_poisoning(nlp, tmp_path):
+    from dispatches_tpu.obs import flight
+    from dispatches_tpu.obs import registry as obs_registry
+    from dispatches_tpu.obs import trace as obs_trace
+
     rng = np.random.default_rng(2)
     profiles = rng.uniform(1.0, 7.0, (8, T))
     profiles[2, 0] = 9.5
     profiles[5, 0] = 9.9
     spec = SweepSpec((grid("price", profiles),))
-    store = run_sweep(
-        nlp, spec, store_dir=tmp_path / "q",
-        options=SweepOptions(chunk_size=4, solver=_poisoned_solver,
-                             max_retries=2))
+    # ride the flight recorder + outcome counters on the same run (the
+    # tier-1 budget cannot afford a second sweep for the obs wiring)
+    pts = obs_registry.counter("sweep.points")
+    before = {ev: pts.value(event=ev) for ev in ("ok", "quarantined")}
+    obs_trace.enable(True)
+    flight.enable(str(tmp_path / "flight"))
+    try:
+        store = run_sweep(
+            nlp, spec, store_dir=tmp_path / "q",
+            options=SweepOptions(chunk_size=4, solver=_poisoned_solver,
+                                 max_retries=2))
+        assert pts.value(event="ok") - before["ok"] == 6
+        assert pts.value(event="quarantined") - before["quarantined"] == 2
+        # each quarantined point dumped one bundle naming its point
+        found = flight.bundles(str(tmp_path / "flight"))
+        assert [b["kind"] for b in found] == ["quarantine", "quarantine"]
+        details = sorted(flight.load_bundle(b["path"])["trigger"]["detail"]
+                         ["point"] for b in found)
+        assert details == [2, 5]
+        insts = [e for e in obs_trace.events()
+                 if e["name"] == "sweep.quarantine"]
+        assert sorted(e["args"]["point"] for e in insts) == [2, 5]
+        retries = [e for e in obs_trace.events()
+                   if e["name"] == "sweep.retry"]
+        assert len(retries) == 4  # 2 points x max_retries
+    finally:
+        flight.reset()
+        obs_trace.enable(False)
+        obs_trace.reset()
     a = store.arrays()
     assert list(a["status"]) == [0, 0, 2, 0, 0, 2, 0, 0]
     assert list(a["retries"]) == [0, 0, 2, 0, 0, 2, 0, 0]
