@@ -244,12 +244,27 @@ PDLP_PRECISION_KEYS = ("pdhg_iters_mean", "solves_per_sec",
                        "obj_rel_err_vs_highs", "refine_rounds_mean",
                        "peak_bytes")
 PDLP_PRECISION_TIERS = ("f32", "bf16x-f32")
-#: sub-keys of the ``serve`` section; the SLO tail metrics may be None
-#: on records predating them, but the keys must be present
+#: sub-keys of the ``serve`` section.  Since r08 the SLO tail metrics
+#: (``serve_p99_ms``/``deadline_miss_rate``) are measured over a
+#: deadline-bearing request stream and must be non-null going forward
 SERVE_KEYS = ("n_requests", "max_batch", "requests_done", "solves_per_sec",
               "slab_solves_per_sec", "overhead_vs_slab", "occupancy_mean",
               "compile_count", "programs", "serve_p99_ms",
               "deadline_miss_rate")
+SERVE_NONNULL_KEYS = ("serve_p99_ms", "deadline_miss_rate")
+#: the execution-plan dispatch A/B (ISSUE 9): the same compiled PDLP
+#: kernel over identical batches, dispatched (a) legacy-style — per-lane
+#: device stacking, fence after every batch, single device — vs (b)
+#: through ExecutionPlan — host-side staging, dispatch-ahead window of
+#: 2, scenario mesh over every host device.  ``donation`` pins the
+#: donated-x0 IPM program's cost card: peak bytes per solve must stay
+#: flat as the number of dispatched batches grows (in-place iterate
+#: update), and the staged x0 input buffer must actually be consumed.
+PLAN_KEYS = ("lanes", "batches", "devices", "inflight", "sync", "ahead",
+             "sps_ratio_ahead_vs_sync", "obj_max_abs_diff", "donation")
+PLAN_ARM_KEYS = ("solves_per_sec", "stage_ms_per_batch")
+PLAN_DONATION_KEYS = ("lanes", "x0_donated", "input_deleted",
+                      "peak_bytes_per_solve_k2", "peak_bytes_per_solve_k8")
 
 
 def validate_bench_output(out):
@@ -293,6 +308,28 @@ def validate_bench_output(out):
         missing = [k for k in SERVE_KEYS if k not in serve]
         if missing:
             raise ValueError(f"bench serve missing sub-keys: {missing}")
+        nulls = [k for k in SERVE_NONNULL_KEYS if serve.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench serve SLO metrics must be measured, not null: "
+                f"{nulls}")
+    plan = out.get("plan")
+    if plan is not None:
+        missing = [k for k in PLAN_KEYS if k not in plan]
+        if missing:
+            raise ValueError(f"bench plan missing sub-keys: {missing}")
+        for arm in ("sync", "ahead"):
+            sub = plan[arm]
+            missing = [k for k in PLAN_ARM_KEYS if k not in sub]
+            if missing:
+                raise ValueError(
+                    f"bench plan[{arm!r}] missing sub-keys: {missing}")
+        donation = plan.get("donation")
+        if donation is not None:
+            missing = [k for k in PLAN_DONATION_KEYS if k not in donation]
+            if missing:
+                raise ValueError(
+                    f"bench plan donation missing sub-keys: {missing}")
     return out
 
 
@@ -644,8 +681,11 @@ def run_bench():
         # max_batch, so the measured round dispatches full lanes only)
         svc.solve_many(nlp, plist[:serve_batch], solver="pdlp",
                        options=serve_opts)
+        # the measured round carries a (generous) deadline so the SLO
+        # tail metrics are computed over deadline-bearing traffic
         t0 = time.perf_counter()
-        rs = svc.solve_many(nlp, plist, solver="pdlp", options=serve_opts)
+        rs = svc.solve_many(nlp, plist, solver="pdlp", options=serve_opts,
+                            deadline_ms=30_000.0)
         serve_s = time.perf_counter() - t0
         sm = svc.metrics()
 
@@ -671,8 +711,8 @@ def run_bench():
             "programs": sm["programs"],
             # SLO-facing tail metrics (gated in the perf ledger): p99
             # end-to-end request latency over the measured round, and
-            # the deadline-miss fraction (0.0 here — the bench stream
-            # carries no deadlines — but the key is the contract)
+            # the miss fraction of its 30s-deadline request stream —
+            # non-null by contract since r08
             "serve_p99_ms": lat.get("p99_ms"),
             "deadline_miss_rate": dl.get("miss_rate"),
         }
@@ -714,6 +754,143 @@ def run_bench():
             }
     except Exception as exc:  # telemetry must never kill the headline
         out["sweep_bench_error"] = str(exc)[:120]
+
+    # ---- execution-plan dispatch A/B (the ISSUE-9 tentpole number):
+    # the same PDLP kernel over identical batches, dispatched
+    # (a) legacy-style — per-lane jnp stacking onto one device, fence
+    # after every batch — vs (b) through ExecutionPlan — host-side
+    # staging, scenario mesh over every local device, dispatch-ahead
+    # window of 2.  On this box the host "devices" may share one core
+    # (nproc can be 1), so the ratio measures staging + dispatch
+    # overhead removed by the plan, not parallel compute ---------------
+    try:
+        from dispatches_tpu.parallel import scenario_mesh
+        from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+        plan_lanes, plan_batches = 64, 6
+        plan_kernel = make_pdlp_solver(nlp, PDLPOptions(
+            tol=1e-2, check_every=50, dtype="float32"))
+        lmps_pl, cfs_pl = _scenarios(plan_lanes * plan_batches,
+                                     np.random.default_rng(13))
+        lane_trees = [
+            {"p": {**params["p"], "lmp": np.asarray(lmps_pl[i] * 1e-3),
+                   "windpower.capacity_factor": np.asarray(cfs_pl[i])},
+             "fixed": params["fixed"]}
+            for i in range(plan_lanes * plan_batches)
+        ]
+        plan_batches_trees = [
+            lane_trees[b * plan_lanes:(b + 1) * plan_lanes]
+            for b in range(plan_batches)
+        ]
+
+        def _legacy_stack(batch):
+            # the pre-plan serve staging: one jnp op per lane per leaf
+            return jax.tree_util.tree_map(
+                lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+                *batch)
+
+        def _run_plan_arm(xplan, label, stage_fn, fence_each):
+            program = xplan.program(plan_kernel, label=label,
+                                    vmap_axes=0, donate_argnums=())
+            # warm: compile + first dispatch outside the timed region
+            xplan.collect(xplan.submit(
+                program, (stage_fn(plan_batches_trees[0]),),
+                n_live=plan_lanes, lanes=plan_lanes))
+            stage_s, tickets = 0.0, []
+            t0 = time.perf_counter()
+            for batch in plan_batches_trees:
+                s0 = time.perf_counter()
+                staged = stage_fn(batch)
+                stage_s += time.perf_counter() - s0
+                ticket = xplan.submit(program, (staged,),
+                                      n_live=plan_lanes, lanes=plan_lanes)
+                if fence_each:  # legacy shape: result before next stage
+                    xplan.collect(ticket)
+                tickets.append(ticket)
+            objs = [np.asarray(xplan.collect(t).obj) for t in tickets]
+            elapsed = time.perf_counter() - t0
+            return elapsed, stage_s, np.concatenate(objs)
+
+        sync_plan = ExecutionPlan(PlanOptions(
+            inflight=1, mesh=None, donate=False))
+        ahead_plan = ExecutionPlan(PlanOptions(
+            inflight=2, mesh=scenario_mesh(), donate=False))
+        sync_s, sync_stage_s, sync_obj = _run_plan_arm(
+            sync_plan, "bench.plan.sync", _legacy_stack, fence_each=True)
+        ahead_s, ahead_stage_s, ahead_obj = _run_plan_arm(
+            ahead_plan, "bench.plan.ahead",
+            lambda batch: ahead_plan.stage(
+                ahead_plan.stack(batch, lanes=plan_lanes),
+                lanes=plan_lanes, donate=False),
+            fence_each=False)
+        n_solves = plan_lanes * plan_batches
+        out["plan"] = {
+            "lanes": plan_lanes,
+            "batches": plan_batches,
+            "devices": len(jax.devices()),
+            "inflight": 2,
+            "sync": {
+                "solves_per_sec": round(n_solves / sync_s, 2),
+                "stage_ms_per_batch": round(
+                    1e3 * sync_stage_s / plan_batches, 2),
+            },
+            "ahead": {
+                "solves_per_sec": round(n_solves / ahead_s, 2),
+                "stage_ms_per_batch": round(
+                    1e3 * ahead_stage_s / plan_batches, 2),
+            },
+            "sps_ratio_ahead_vs_sync": round(sync_s / ahead_s, 3),
+            # sharded reductions may reorder; report, don't assert
+            "obj_max_abs_diff": float(np.max(np.abs(sync_obj - ahead_obj))),
+            "donation": None,
+        }
+
+        # donation sub-probe: the donated-x0 IPM program's cost card.
+        # Peak bytes per solve must stay flat as the dispatched batch
+        # count grows (in-place iterate update, no per-batch realloc),
+        # and the staged x0 buffer must actually be consumed.
+        if time.monotonic() < deadline:
+            from dispatches_tpu.obs import profile as obs_profile
+            from dispatches_tpu.solvers import IPMOptions, make_ipm_solver
+
+            obs_profile.enable(True)  # before the program is built
+            d_lanes = 8
+            dplan = ExecutionPlan(PlanOptions(inflight=2, mesh=None))
+            dprog = dplan.program(
+                make_ipm_solver(nlp, IPMOptions(max_iter=10)),
+                label="bench.plan.donate", vmap_axes=(0, 0),
+                donate_argnums=(1,))
+            x0_stack = np.stack(
+                [np.asarray(nlp.x0) * np.asarray(nlp.var_scale)] * d_lanes)
+            dparams = dplan.stage(dplan.stack([params] * d_lanes),
+                                  lanes=d_lanes, donate=False)
+
+            def _donate_stream(k):
+                last_x0 = None
+                for _ in range(k):
+                    last_x0 = dplan.stage(x0_stack, lanes=d_lanes,
+                                          donate=True)
+                    dplan.submit(dprog, (dparams, last_x0),
+                                 n_live=d_lanes, lanes=d_lanes)
+                dplan.drain()
+                cards = obs_profile.cards_for("bench.plan.donate")
+                peak = cards[-1]["peak_bytes"] if cards else None
+                return last_x0, peak
+
+            x0_k2, peak_k2 = _donate_stream(2)
+            x0_k8, peak_k8 = _donate_stream(8)
+            out["plan"]["donation"] = {
+                "lanes": d_lanes,
+                "x0_donated": True,
+                "input_deleted": bool(x0_k2.is_deleted()
+                                      and x0_k8.is_deleted()),
+                "peak_bytes_per_solve_k2": (
+                    peak_k2 // d_lanes if peak_k2 else None),
+                "peak_bytes_per_solve_k8": (
+                    peak_k8 // d_lanes if peak_k8 else None),
+            }
+    except Exception as exc:  # telemetry must never kill the headline
+        out["plan_bench_error"] = str(exc)[:120]
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------
@@ -873,6 +1050,11 @@ def _run_child(force_cpu: bool, timeout_s: float):
     env = dict(os.environ, **{CHILD_ENV: "1"})
     if force_cpu:
         env["DISPATCHES_BENCH_FORCE_CPU"] = "1"
+        # give the plan A/B section a host mesh to shard over
+        flag = "--xla_force_host_platform_device_count=8"
+        xla = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla:
+            env["XLA_FLAGS"] = f"{xla} {flag}".strip()
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            capture_output=True, text=True, timeout=timeout_s,

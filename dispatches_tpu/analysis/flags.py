@@ -72,6 +72,12 @@ REGISTERED_FLAGS = {
     "PDLP_REFINE_ROUNDS": "override PDLPOptions.refine_rounds, the max "
     "high-tier iterative-refinement epochs appended to a low-precision "
     "PDLP solve (solvers.pdlp.resolve_pdlp_refine_rounds)",
+    "PLAN_INFLIGHT": "execution-plan dispatch-ahead window: max batches "
+    "dispatched but not yet fenced (plan.PlanOptions.from_env; default "
+    "2, 1 = fully synchronous dispatch)",
+    "PLAN_DEVICES": "execution-plan device count for its scenario mesh "
+    "(plan.PlanOptions.from_env; unset/1 = single-device placement, "
+    "N > 1 builds parallel.scenario_mesh(N))",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

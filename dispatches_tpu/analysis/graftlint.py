@@ -35,6 +35,17 @@ GL007 unfenced-timing       a ``time.perf_counter()``/``time.time()``
                             dispatch is asynchronous, so the stop
                             timestamp measures dispatch latency, not
                             the solve (the sweep points/s bug).
+GL008 dispatch-outside-plan placement/dispatch decisions made outside
+                            ``dispatches_tpu/plan/``: an explicit-
+                            placement ``jax.device_put(x, sharding)``
+                            anywhere else, or a ``jit``/``pjit``/
+                            ``graft_jit`` call inside the thin caller
+                            layers (``serve``/``sweep``/``parallel``) —
+                            those route batches through
+                            ``ExecutionPlan`` (``stage``/``program``/
+                            ``submit``), which owns mesh placement,
+                            donation safety, and the dispatch-ahead
+                            window.
 
 Findings are reported as ``file:line rule-id message`` and fingerprinted
 by (relpath, rule, normalized source line) — line-number independent, so
@@ -66,6 +77,7 @@ RULES: Dict[str, str] = {
     "GL005": "bare-astype-f64",
     "GL006": "unregistered-env-flag",
     "GL007": "unfenced-timing",
+    "GL008": "dispatch-outside-plan",
 }
 
 DEFAULT_BASELINE = Path(__file__).with_name("graftlint.baseline")
@@ -125,6 +137,11 @@ _HOT_RE = re.compile(r"(^|[^a-z])(hour|hr|day|date)s?([^a-z]|$)")
 _JIT_WRAPPERS = {"jit", "pjit", "graft_jit"}
 _TIMER_ATTRS = {"perf_counter", "perf_counter_ns", "time", "monotonic"}
 _FENCE_NAMES = {"block_until_ready", "fence"}
+# GL008: the one package allowed to make placement/dispatch decisions,
+# and the thin-caller layers that must route through it
+_PLAN_PACKAGE = "dispatches_tpu/plan/"
+_DISPATCH_DIRS = ("dispatches_tpu/serve/", "dispatches_tpu/sweep/",
+                  "dispatches_tpu/parallel/")
 
 
 def _base_name(func: ast.expr) -> Optional[str]:
@@ -413,6 +430,7 @@ class _Linter:
         self._check_gl003(node)
         self._check_gl005(node, base)
         self._check_gl006(node, base)
+        self._check_gl008(node, base)
 
     def _check_gl001(self, node: ast.Call, base: Optional[str]) -> None:
         if (isinstance(node.func, ast.Name) and base in _HOST_CASTS
@@ -559,6 +577,39 @@ class _Linter:
                 "jax.config.jax_enable_x64 — under DISPATCHES_TPU_NO_X64 "
                 "this silently degrades to f32; guard or warn on the "
                 "x64 state",
+            )
+
+    def _check_gl008(self, node: ast.Call, base: Optional[str]) -> None:
+        if self.relpath.startswith(_PLAN_PACKAGE):
+            return
+        # (a) explicit placement anywhere outside the plan package: a
+        # device_put that *decides* where the buffer lives (2nd
+        # positional arg or device=/sharding= kwarg; a bare 1-arg
+        # device_put just commits to the default device and is fine)
+        if base == "device_put":
+            explicit = (len(node.args) >= 2
+                        or any(kw.arg in ("device", "sharding")
+                               for kw in node.keywords))
+            if explicit:
+                self._emit(
+                    node, "GL008",
+                    "explicit-placement `device_put` outside "
+                    "dispatches_tpu/plan/ — placement policy lives in "
+                    "ExecutionPlan.stage(); route the batch through the "
+                    "plan (or add a justified baseline entry)",
+                )
+                return
+        # (b) building compiled dispatch targets inside the thin-caller
+        # layers — serve/sweep/parallel submit ExecutionPlan programs
+        # instead of owning their own jit'd entry points
+        if (base in _JIT_WRAPPERS
+                and self.relpath.startswith(_DISPATCH_DIRS)):
+            self._emit(
+                node, "GL008",
+                f"`{base}()` inside {self.relpath.split('/')[1]}/ — the "
+                "serve/sweep/parallel layers are thin ExecutionPlan "
+                "callers; build the compiled target with plan.program() "
+                "so donation and dispatch-ahead accounting apply",
             )
 
     def _flag_value(self, name: str, node: ast.AST) -> None:

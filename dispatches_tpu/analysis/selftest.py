@@ -155,6 +155,30 @@ CORPUS: Dict[str, Dict[str, str]] = {
             algo = os.environ.get("DISPATCHES_TPU_PDLP_ALGO")
             prec = os.environ.get("DISPATCHES_TPU_PDLP_PRECISION")
             rounds = os.environ.get("DISPATCHES_TPU_PDLP_REFINE_ROUNDS")
+            inflight = os.environ.get("DISPATCHES_TPU_PLAN_INFLIGHT")
+            ndev = os.environ.get("DISPATCHES_TPU_PLAN_DEVICES")
+        """,
+    },
+    "GL008": {
+        "bad": """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def stage(arr, mesh):
+                sh = NamedSharding(mesh, PartitionSpec("scenario"))
+                return jax.device_put(arr, sh)
+        """,
+        "good": """
+            import jax
+
+            def stage(plan, program, per_lane, lanes, n_live):
+                batched = plan.stage(plan.stack(per_lane, lanes=lanes),
+                                     lanes=lanes, donate=program.donates)
+                ticket = plan.submit(program, (batched,),
+                                     n_live=n_live, lanes=lanes)
+                # committing to the default device decides nothing
+                warm = jax.device_put(per_lane[0])
+                return plan.collect(ticket), warm
         """,
     },
 }

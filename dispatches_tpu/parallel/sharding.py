@@ -1,4 +1,14 @@
-"""Scenario-batch sharding of solver sweeps over a device mesh."""
+"""Scenario-batch sharding of solver sweeps over a device mesh.
+
+Since the execution-plan refactor this module is a thin caller of
+:class:`dispatches_tpu.plan.ExecutionPlan`: it keeps the public
+contract (key validation, mesh-multiple padding, pad trimming) and
+delegates placement + dispatch to the plan.  Caller-visible arrays are
+never donated — the ``scenario_sharded_solver`` contract hands device
+arrays straight through, and ``jax.device_put`` onto an identical
+sharding returns the *same* buffer, so donation here could delete a
+caller's array out from under it.
+"""
 
 from __future__ import annotations
 
@@ -7,7 +17,7 @@ from typing import Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 
@@ -30,6 +40,7 @@ def scenario_sharded_solver(
     axis: str = "scenario",
     full_result: bool = False,
     solver=None,
+    plan=None,
 ):
     """Build ``solve(batched) -> objs`` where ``batched`` maps param (or
     fixed-var) names to arrays with a leading scenario axis; that axis is
@@ -38,7 +49,9 @@ def scenario_sharded_solver(
     ``solver`` is any jit/vmap-compatible ``callable(params) -> result``
     with an ``.obj`` field (e.g. ``make_pdlp_solver(nlp, ...)`` for the
     LP fast path); by default a batched IPM is built from ``options`` /
-    ``max_iter``.
+    ``max_iter``.  ``plan`` injects a caller-owned
+    :class:`~dispatches_tpu.plan.ExecutionPlan` (it must carry ``mesh``);
+    None builds a non-donating plan around ``mesh``.
 
     Batches that do not divide the mesh size are padded by repeating
     the last scenario (the 366-day annual sweep on an 8-device mesh is
@@ -57,22 +70,26 @@ def scenario_sharded_solver(
             "prebuilt solver, configure it at construction instead"
         )
 
+    from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+    xplan = plan if plan is not None else ExecutionPlan(
+        PlanOptions(mesh=mesh, axis=axis, donate=False))
+
     defaults = nlp.default_params()
     in_axes_p = {k: (0 if k in batched_keys else None) for k in defaults["p"]}
     in_axes_f = {
         k: (0 if k in batched_fixed_keys else None) for k in defaults["fixed"]
     }
-    vsolver = jax.vmap(solver, in_axes=({"p": in_axes_p, "fixed": in_axes_f},))
+    # objective extraction inside the compiled program (XLA dead-code-
+    # eliminates the unused result fields), exactly as before the plan
+    kernel = solver if full_result else (lambda params: solver(params).obj)
+    program = xplan.program(
+        kernel, label="parallel.mesh",
+        vmap_axes=({"p": in_axes_p, "fixed": in_axes_f},),
+        donate_argnums=())
 
-    batch_sh = NamedSharding(mesh, P(axis))
-    repl_sh = NamedSharding(mesh, P())
     n_dev = int(mesh.shape[axis])  # the batch axis only needs to divide
     # its own mesh dimension
-
-    @jax.jit
-    def _run(params):
-        res = vsolver(params)
-        return res if full_result else res.obj
 
     def solve(batched: Dict[str, np.ndarray]):
         declared = set(batched_keys) | set(batched_fixed_keys)
@@ -97,6 +114,7 @@ def scenario_sharded_solver(
             )
         n_scen = sizes.pop()
         pad = (-n_scen) % n_dev
+        lanes = n_scen + pad
 
         p = dict(defaults["p"])
         f = dict(defaults["fixed"])
@@ -111,18 +129,20 @@ def scenario_sharded_solver(
                     [arr, jnp.repeat(arr[-1:], pad, axis=0)]
                 )
             if k in p:
-                p[k] = jax.device_put(arr, batch_sh)
+                p[k] = arr
             elif k in f:
-                f[k] = jax.device_put(arr, batch_sh)
+                f[k] = arr
             else:
                 raise KeyError(f"unknown param/fixed var {k!r}")
-        for k in list(p.keys()):
-            if k not in batched:
-                p[k] = jax.device_put(jnp.asarray(p[k]), repl_sh)
-        for k in list(f.keys()):
-            if k not in batched:
-                f[k] = jax.device_put(jnp.asarray(f[k]), repl_sh)
-        out = _run({"p": p, "fixed": f})
+        mask = {
+            "p": {k: k in batched for k in p},
+            "fixed": {k: k in batched for k in f},
+        }
+        staged = xplan.stage({"p": p, "fixed": f}, lanes=lanes,
+                             donate=False, batched=mask)
+        ticket = xplan.submit(program, (staged,),
+                              n_live=n_scen, lanes=lanes)
+        out = xplan.collect(ticket)
         if pad:
             out = jax.tree_util.tree_map(lambda a: a[:n_scen], out)
         return out

@@ -1,0 +1,14 @@
+"""One execution-plan layer: sharded, donation-aware, dispatch-ahead
+batching for every solve path (serve, sweep, parallel).
+
+See :mod:`dispatches_tpu.plan.execution` and docs/execution_plan.md.
+"""
+
+from dispatches_tpu.plan.execution import (
+    ExecutionPlan,
+    PlanOptions,
+    PlanProgram,
+    PlanTicket,
+)
+
+__all__ = ["ExecutionPlan", "PlanOptions", "PlanProgram", "PlanTicket"]

@@ -1,0 +1,352 @@
+"""``ExecutionPlan``: the one dispatch path for every solve batch.
+
+Before this layer, three backends each made their own batching and
+placement decisions: serve stacked + ``device_put`` + fenced every
+micro-batch synchronously, the sweep engine built its own
+``jit(vmap(...))``, and ``parallel.scenario_sharded_solver`` carried a
+third copy of the mesh/padding logic.  The plan owns all of it now —
+serve, sweep, and the sharded solver are thin callers (graftlint GL008
+rejects new ``device_put``/``jit`` placement decisions outside this
+package).
+
+One plan = one placement policy plus one dispatch pipeline:
+
+* **placement** — an optional 1-D ``jax.sharding.Mesh`` over a
+  ``scenario`` axis.  ``stage()`` puts batched leaves on
+  ``NamedSharding(mesh, P(axis))`` whenever the padded lane count
+  divides the mesh, replicated leaves on ``P()``; with no mesh every
+  leaf is simply committed to the default device.  Lane counts come
+  from the serve bucket menu (``serve.bucket.pad_lanes``), so each
+  (program, lane-count) pair still lowers exactly once.
+* **donation** — programs built with ``donate=True`` pass
+  ``donate_argnums`` through ``graft_jit`` to ``jax.jit``, so the
+  staged batch state (params stack, warm-start ``x0`` stack) is donated
+  to the solve and XLA updates PDHG/IPM iterates in place instead of
+  reallocating per batch.  ``stage()`` guarantees donation safety: a
+  leaf that is already a committed ``jax.Array`` owned by the caller is
+  copied first, so donation can only ever delete plan-staged buffers.
+  Callers that hand out caller-owned device arrays (the
+  ``scenario_sharded_solver`` contract) build their programs with
+  ``donate=False``.
+* **dispatch-ahead** — ``submit()`` returns immediately (JAX async
+  dispatch); completed results are fenced in FIFO order, and the number
+  of dispatched-but-unfenced batches is bounded by
+  ``PlanOptions.inflight`` (default 2: batch *k+1* stages and
+  dispatches while batch *k* computes).  ``collect()``/``drain()``
+  fence.  The ``plan.inflight`` gauge and retroactive ``plan.dispatch``
+  spans expose the pipeline to ``dispatches_tpu.obs``.
+
+See ``docs/execution_plan.md`` for the lifecycle and donation rules.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.obs import registry as obs_registry
+from dispatches_tpu.obs import trace as obs_trace
+
+__all__ = ["PlanOptions", "PlanProgram", "PlanTicket", "ExecutionPlan"]
+
+
+@dataclass(frozen=True)
+class PlanOptions:
+    """Placement + pipeline knobs for one :class:`ExecutionPlan`."""
+
+    #: dispatch-ahead window: max batches dispatched but not yet fenced.
+    #: 2 = double buffering (stage k+1 while k computes); 1 = fully
+    #: synchronous dispatch (every submit fences the previous batch).
+    inflight: int = 2
+    #: build a ``parallel.scenario_mesh(devices)`` when no explicit mesh
+    #: is given (None/1 = single-device placement).
+    devices: Optional[int] = None
+    #: explicit 1-D device mesh; wins over ``devices``.
+    mesh: Optional[object] = None
+    #: mesh axis the batch lane dimension shards over.
+    axis: str = "scenario"
+    #: default donation policy for ``program()`` — donate the staged
+    #: batch state so solver iterates update in place.
+    donate: bool = True
+
+    @classmethod
+    def from_env(cls, **overrides) -> "PlanOptions":
+        """Defaults with ``DISPATCHES_TPU_PLAN_*`` env overrides applied
+        (flags registered in ``analysis.flags``; GL006)."""
+        env = {}
+        raw = os.environ.get(flag_name("PLAN_INFLIGHT"), "")
+        if raw:
+            env["inflight"] = int(raw)
+        raw = os.environ.get(flag_name("PLAN_DEVICES"), "")
+        if raw:
+            env["devices"] = int(raw)
+        env.update(overrides)
+        return cls(**env)
+
+
+class PlanProgram:
+    """One compiled dispatch target: ``graft_jit`` (compile-counted)
+    over an optionally vmapped kernel, plus its donation contract.
+
+    Built via :meth:`ExecutionPlan.program`; called only through
+    :meth:`ExecutionPlan.submit`.  ``_graft_counter`` is the PR-1
+    recompile-accounting counter (``assert_no_recompiles`` /
+    ``metrics()['compile_count']`` keep working unchanged).
+    """
+
+    __slots__ = ("plan", "label", "donate_argnums", "_run",
+                 "_graft_counter")
+
+    def __init__(self, plan: "ExecutionPlan", fn: Callable, *, label: str,
+                 vmap_axes=None, donate_argnums: Sequence[int] = ()):
+        self.plan = plan
+        self.label = label
+        self.donate_argnums = tuple(donate_argnums)
+        if vmap_axes is not None:
+            fn = jax.vmap(fn, in_axes=vmap_axes)
+        kw = {}
+        if self.donate_argnums:
+            kw["donate_argnums"] = self.donate_argnums
+        self._run = graft_jit(fn, label=label, **kw)
+        self._graft_counter = self._run._graft_counter
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums)
+
+    @property
+    def compiles(self) -> int:
+        return self._graft_counter.count
+
+
+class PlanTicket:
+    """One dispatched batch: a future fenced by ``collect``/``drain``."""
+
+    __slots__ = ("label", "lanes", "n_live", "result", "_raw", "_done",
+                 "_on_done", "_t_dispatch_us")
+
+    def __init__(self, label: str, lanes: int, n_live: int, on_done):
+        self.label = label
+        self.lanes = lanes
+        self.n_live = n_live
+        self.result = None
+        self._raw = None
+        self._done = False
+        self._on_done = on_done
+        self._t_dispatch_us = 0.0
+
+    def done(self) -> bool:
+        return self._done
+
+
+def _stack_leaves(leaves: Sequence) -> Any:
+    """Stack one leaf across lanes.  Host-resident leaves (numpy /
+    scalars) stack on the host — one C memcpy and ONE host→device
+    transfer at stage time, instead of a device op per lane.  A leaf
+    set containing device arrays stacks on device to avoid a
+    device→host round-trip.  Either way the values are bitwise
+    identical to per-lane ``jnp.asarray`` + ``jnp.stack``."""
+    if any(isinstance(leaf, jax.Array) for leaf in leaves):
+        return jnp.stack([jnp.asarray(leaf) for leaf in leaves])
+    return np.stack([np.asarray(leaf) for leaf in leaves])
+
+
+class ExecutionPlan:
+    """Maps a stream of solve batches onto a mesh placement with
+    donation and a bounded dispatch-ahead pipeline (module docstring).
+
+    Typical flow (serve/sweep/parallel are exactly this)::
+
+        plan = ExecutionPlan(PlanOptions.from_env())
+        prog = plan.program(kernel, label="serve.pdlp#0", vmap_axes=0)
+        batched = plan.stage(plan.stack(per_lane_params, lanes=lanes),
+                             lanes=lanes, donate=prog.donates)
+        ticket = plan.submit(prog, (batched,), n_live=n, lanes=lanes)
+        ...                      # stage/submit the next batch meanwhile
+        result = plan.collect(ticket)
+    """
+
+    def __init__(self, options: Optional[PlanOptions] = None):
+        self.options = options if options is not None else PlanOptions.from_env()
+        mesh = self.options.mesh
+        if mesh is None and (self.options.devices or 0) > 1:
+            # lazy import: parallel.sharding is a plan caller
+            from dispatches_tpu.parallel.sharding import scenario_mesh
+
+            mesh = scenario_mesh(self.options.devices,
+                                 axis=self.options.axis)
+        self.mesh = mesh
+        self._window: Deque[PlanTicket] = deque()
+        self._gauge = obs_registry.gauge(
+            "plan.inflight",
+            "execution-plan batches dispatched but not yet fenced")
+        self._gauge.set(0.0)
+        self._obs_batches = obs_registry.counter(
+            "plan.batches", "batches dispatched through the execution "
+            "plan (label = program)")
+
+    # -- placement ---------------------------------------------------------
+
+    @property
+    def inflight(self) -> int:
+        """Batches currently dispatched but not yet fenced."""
+        return len(self._window)
+
+    def _axis_name(self) -> str:
+        names = self.mesh.axis_names
+        return self.options.axis if self.options.axis in names else names[0]
+
+    def _mesh_dim(self) -> int:
+        return int(self.mesh.shape[self._axis_name()])
+
+    def lanes_for(self, n_live: int, max_batch: int) -> int:
+        """Shape-stable lane count from the serve bucket menu."""
+        from dispatches_tpu.serve.bucket import pad_lanes
+
+        return pad_lanes(n_live, max_batch)
+
+    def sharding_for(self, lanes: int):
+        """``NamedSharding`` over the scenario axis when ``lanes``
+        divides the mesh; None (single-device / no mesh) otherwise —
+        deterministic per lane count, so the one-program-per-
+        (program, lane-count) accounting is unchanged."""
+        if self.mesh is not None and lanes % self._mesh_dim() == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(self.mesh, PartitionSpec(self._axis_name()))
+        return None
+
+    def replicated_sharding(self):
+        """Placement for leaves every device needs whole (None without
+        a mesh)."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    # -- staging -----------------------------------------------------------
+
+    def stack(self, trees: Sequence, *, lanes: Optional[int] = None):
+        """Stack per-lane pytrees into one batched pytree, padding to
+        ``lanes`` by repeating the last entry (padded lanes replay a
+        well-posed solve and are sliced off by the caller)."""
+        trees = list(trees)
+        if lanes is not None and lanes > len(trees):
+            trees.extend([trees[-1]] * (lanes - len(trees)))
+        return jax.tree_util.tree_map(lambda *ls: _stack_leaves(ls), *trees)
+
+    def stage(self, tree, *, lanes: int, donate: bool = True, batched=True):
+        """Place one batched pytree for dispatch.
+
+        ``batched`` is True (every leaf carries the lane axis), False
+        (fully replicated), or a matching pytree of bools for mixed
+        trees (the sweep's swept-vs-default split).  With ``donate``
+        (the default) every staged leaf is guaranteed plan-owned: a
+        leaf that is already a caller-owned ``jax.Array`` is copied, so
+        a donating program can never delete a buffer the caller still
+        holds."""
+        shard = self.sharding_for(lanes)
+        repl = self.replicated_sharding()
+
+        def place(leaf, is_batched=True):
+            arr = jnp.asarray(leaf)
+            if donate and arr is leaf:
+                arr = jnp.array(arr, copy=True)
+            sh = shard if is_batched else repl
+            if sh is not None:
+                arr = jax.device_put(arr, sh)
+            return arr
+
+        if batched is True or batched is False:
+            return jax.tree_util.tree_map(
+                lambda leaf: place(leaf, batched), tree)
+        # mixed trees: ``batched`` is a matching pytree of plain bools
+        # (True = lane axis, False = replicated; bools, not vmap axes,
+        # because None is not a pytree leaf)
+        return jax.tree_util.tree_map(
+            lambda leaf, b: place(leaf, bool(b)), tree, batched)
+
+    # -- programs ----------------------------------------------------------
+
+    def program(self, fn: Callable, *, label: str, vmap_axes=None,
+                donate: Optional[bool] = None,
+                donate_argnums: Optional[Sequence[int]] = None,
+                n_args: int = 1) -> PlanProgram:
+        """Build the compiled dispatch target for one kernel.
+
+        ``vmap_axes`` (if given) vmaps ``fn`` first.  Donation:
+        explicit ``donate_argnums`` wins; otherwise ``donate`` (plan
+        default) donates all ``n_args`` positional batch-state args."""
+        if donate_argnums is None:
+            donate = self.options.donate if donate is None else donate
+            donate_argnums = tuple(range(n_args)) if donate else ()
+        return PlanProgram(self, fn, label=label, vmap_axes=vmap_axes,
+                           donate_argnums=donate_argnums)
+
+    # -- dispatch pipeline -------------------------------------------------
+
+    def submit(self, program: PlanProgram, args: Tuple, *,
+               n_live: int, lanes: int,
+               on_done: Optional[Callable[[PlanTicket], None]] = None,
+               ) -> PlanTicket:
+        """Dispatch one staged batch asynchronously.
+
+        Returns immediately with a ticket; when the in-flight window is
+        full the OLDEST batch is fenced first (continuous batching: a
+        freed slot is what admits the next dispatch).  ``on_done`` runs
+        at fence time with the completed ticket."""
+        ticket = PlanTicket(program.label, lanes, n_live, on_done)
+        ticket._t_dispatch_us = obs_trace.now_us() if obs_trace.enabled() else 0.0
+        ticket._raw = program._run(*args)
+        self._window.append(ticket)
+        self._obs_batches.inc(label=program.label)
+        self._gauge.set(float(len(self._window)))
+        window = max(int(self.options.inflight), 1)
+        while len(self._window) > window:
+            self._complete_oldest()
+        return ticket
+
+    def collect(self, ticket: PlanTicket):
+        """Fence batches (oldest first) until this ticket completes;
+        returns its result pytree (device computation finished)."""
+        while not ticket._done:
+            if not self._window:
+                raise RuntimeError(
+                    f"ticket for {ticket.label!r} is neither in flight "
+                    "nor complete — was it submitted through this plan?")
+            self._complete_oldest()
+        return ticket.result
+
+    def drain(self) -> int:
+        """Fence every in-flight batch; returns how many were fenced."""
+        n = 0
+        while self._window:
+            self._complete_oldest()
+            n += 1
+        return n
+
+    def _complete_oldest(self) -> PlanTicket:
+        ticket = self._window.popleft()
+        ticket.result = jax.block_until_ready(ticket._raw)
+        ticket._raw = None
+        ticket._done = True
+        self._gauge.set(float(len(self._window)))
+        if obs_trace.enabled():
+            end_us = obs_trace.now_us()
+            obs_trace.complete(
+                "plan.dispatch", ticket._t_dispatch_us,
+                end_us - ticket._t_dispatch_us, label=ticket.label,
+                lanes=ticket.lanes, live=ticket.n_live,
+                inflight=len(self._window))
+        if ticket._on_done is not None:
+            ticket._on_done(ticket)
+        return ticket

@@ -11,6 +11,14 @@ keys its cache on).  That triple is the *bucket fingerprint*; within a
 bucket only the lane count (batch width) can vary, and it is snapped to
 a small fixed menu of power-of-two widths so a bucket compiles a
 handful of programs once and then replays forever.
+
+The lane menu is also the shape vocabulary of the execution-plan layer:
+``plan.ExecutionPlan.lanes_for`` delegates to :func:`pad_lanes`, so
+serve batches, sweep chunks, and plan-staged transfers all pad to the
+same widths and share the one-compile-per-(program, lane-count)
+guarantee.  Stacking/padding/placement of the padded batch itself lives
+in ``dispatches_tpu.plan`` (``stack``/``stage``) — this module only
+decides *which* width a batch snaps to.
 """
 
 from __future__ import annotations

@@ -12,13 +12,22 @@ vmapped kernel per bucket — so each (bucket, lane-count) pair lowers
 once and replays forever (the PR-1 ``graft_jit``/``assert_no_recompiles``
 contract, observable via ``metrics()['compile_count']``).
 
+All dispatch goes through the :class:`dispatches_tpu.plan.ExecutionPlan`
+layer: the plan owns device placement (mesh sharding), buffer donation
+(the staged params/x0 stacks are donated so solver iterates update in
+place), and the dispatch-ahead pipeline — ``flush_all``/``solve_many``
+stage and dispatch batch *k+1* while batch *k* computes, bounded by the
+plan's in-flight window.  The service keeps only the queueing policy.
+
 Dispatch policy
 ---------------
 * a bucket flushes when it reaches ``max_batch`` pending requests;
 * any bucket whose OLDEST request has waited ``max_wait_ms`` flushes on
-  the next ``submit``/``poll`` (the service is synchronous and
-  single-threaded by design — determinism over threads; an async
-  front-end can call ``poll()`` from its own timer);
+  the next ``submit``/``poll`` (dispatch is synchronous and
+  deterministic; an async front-end can call ``poll()`` from its own
+  timer — queue mutation is guarded by a lock, and all host-side
+  staging [warm-start cast, stacking, host→device transfer] happens
+  OUTSIDE that lock, so submit latency does not scale with batch size);
 * the total queue is bounded by ``max_queue``: when full, the bucket
   holding the oldest pending request is flushed first (backpressure,
   oldest-first) before the new request is accepted;
@@ -39,23 +48,21 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
-from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import (
     freeze_options,
-    pad_lanes,
     params_signature,
     request_fingerprint,
 )
@@ -65,6 +72,7 @@ from dispatches_tpu.serve.metrics import (
     QueueWaitWindow,
     format_stats,
 )
+from dispatches_tpu.plan import ExecutionPlan, PlanOptions
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 from dispatches_tpu.solvers.pdlp import (
     PDLPOptions,
@@ -109,6 +117,12 @@ class ServeOptions:
     #: Lane counts map deterministically to one sharding each, so the
     #: one-program-per-(bucket, lane-count) accounting is unchanged.
     mesh: Optional[object] = None
+    #: caller-owned :class:`dispatches_tpu.plan.ExecutionPlan` — the
+    #: dispatch layer the service routes every batch through.  None
+    #: (default) builds one from ``PlanOptions.from_env()`` with this
+    #: options' ``mesh``, so ``DISPATCHES_TPU_PLAN_INFLIGHT`` /
+    #: ``DISPATCHES_TPU_PLAN_DEVICES`` plumb straight through.
+    plan: Optional[object] = None
     #: service-level default precision tier for the buckets this service
     #: builds (same vocabulary as ``PDLPOptions.precision`` /
     #: ``IPMOptions.precision``: "f32" | "bf16x-f32" | "f32-f64").
@@ -239,10 +253,12 @@ class _WarmStartCache:
 
 
 class _Bucket:
-    """One shape bucket: a resolved solver kind, its jitted vmapped
-    kernel (compile-counted via graft_jit), and the pending queue."""
+    """One shape bucket: a resolved solver kind, its plan-compiled
+    vmapped kernel (compile-counted via graft_jit inside
+    ``ExecutionPlan.program``), and the pending queue."""
 
-    def __init__(self, nlp, solver: str, options: Dict, label: str):
+    def __init__(self, nlp, solver: str, options: Dict, label: str,
+                 plan: ExecutionPlan):
         self.nlp = nlp
         self.pending: "deque[SolveHandle]" = deque()
         kind = solver.lower()
@@ -289,17 +305,23 @@ class _Bucket:
         ).labeled(bucket=label)
         if kind == "ipm":
             # x0 always passed: one compiled signature per lane count
-            # whether lanes are cold (default x0) or warm-started
+            # whether lanes are cold (default x0) or warm-started.
+            # The x0 stack is the donatable batch state: its buffer
+            # aliases the output iterate, so XLA updates it in place
+            # (params carry no alias-compatible output — donating them
+            # would be a no-op; see docs/execution_plan.md).
             self.default_x0 = np.asarray(nlp.x0) * np.asarray(nlp.var_scale)
-            self.run = graft_jit(jax.vmap(base, in_axes=(0, 0)),
-                                 label=f"serve.{label}")
+            self.program = plan.program(
+                base, label=f"serve.{label}", vmap_axes=(0, 0),
+                donate_argnums=(1,) if plan.options.donate else ())
         else:
             self.default_x0 = None
-            self.run = graft_jit(jax.vmap(base), label=f"serve.{label}")
+            self.program = plan.program(base, label=f"serve.{label}",
+                                        vmap_axes=0, donate_argnums=())
 
     @property
     def compiles(self) -> int:
-        return self.run._graft_counter.count
+        return self.program.compiles
 
 
 class SolveService:
@@ -313,6 +335,14 @@ class SolveService:
                  clock: Callable[[], float] = time.monotonic):
         self.options = options if options is not None else ServeOptions.from_env()
         self._clock = clock
+        # the one dispatch path: placement, donation, and the
+        # dispatch-ahead window all live in the plan
+        self.plan = (self.options.plan if self.options.plan is not None
+                     else ExecutionPlan(
+                         PlanOptions.from_env(mesh=self.options.mesh)))
+        # guards queue mutation only — host-side staging (warm-start
+        # cast, stacking, host→device transfer) runs outside it
+        self._lock = threading.RLock()
         self._buckets: Dict = {}
         self._latency = LatencyWindow(self.options.latency_window)
         self._queue_wait = QueueWaitWindow(self.options.latency_window)
@@ -370,7 +400,7 @@ class SolveService:
             label = f"{solver.lower()}#{len(self._buckets)}"
             if base_solver is not None:
                 opts["base_solver"] = base_solver
-            bucket = _Bucket(nlp, solver, opts, label)
+            bucket = _Bucket(nlp, solver, opts, label, self.plan)
             self._buckets[key] = bucket
         return bucket
 
@@ -416,13 +446,15 @@ class SolveService:
             # carried over from a different-precision solve (or a
             # caller-supplied f32 vector) must not retrace the bucket's
             # compiled signature or poison the lanes it shares a stack
-            # with
+            # with.  This cast (and the cache lookup above) is host-side
+            # staging and deliberately runs BEFORE the lock below.
             handle.x0 = np.asarray(
                 bucket.default_x0 if x0 is None else x0,
                 dtype=bucket.default_x0.dtype)
-        bucket.pending.append(handle)
-        bucket.stats.record_submitted()
-        self._submitted += 1
+        with self._lock:
+            bucket.pending.append(handle)
+            bucket.stats.record_submitted()
+            self._submitted += 1
         self._obs_submitted.inc()
         if len(bucket.pending) >= self.options.max_batch:
             self._flush_bucket(bucket)
@@ -463,11 +495,20 @@ class SolveService:
         return n
 
     def flush_all(self) -> int:
-        """Drain every pending request; returns how many were handled."""
+        """Drain every pending request; returns how many were handled.
+
+        This is the dispatch-ahead path: batches are staged and
+        dispatched back-to-back through the plan (batch *k+1*'s host
+        staging and host→device transfer overlap batch *k*'s compute,
+        bounded by the plan's in-flight window), then the plan drains.
+        Continuous batching falls out of the window: the plan fences
+        its oldest batch exactly when a new dispatch needs the slot.
+        """
         n = 0
         for bucket in list(self._buckets.values()):
             while bucket.pending:
-                n += self._flush_bucket(bucket)
+                n += self._dispatch_bucket(bucket)[0]
+        self.plan.drain()
         return n
 
     def _queue_depth(self) -> int:
@@ -486,13 +527,27 @@ class SolveService:
         return 0 if oldest is None else self._flush_bucket(oldest)
 
     def _flush_bucket(self, bucket: _Bucket) -> int:
-        """Dispatch up to max_batch requests from one bucket; returns
-        the number of requests completed (solved or timed out)."""
-        n = min(len(bucket.pending), self.options.max_batch)
-        if n == 0:
-            return 0
-        self._flushes += 1
-        requests = [bucket.pending.popleft() for _ in range(n)]
+        """Synchronous flush: dispatch one batch through the plan and
+        fence it; returns the number of requests completed (solved or
+        timed out).  ``flush_all`` uses ``_dispatch_bucket`` directly
+        to pipeline instead."""
+        n, ticket = self._dispatch_bucket(bucket)
+        if ticket is not None:
+            self.plan.collect(ticket)
+        return n
+
+    def _dispatch_bucket(self, bucket: _Bucket):
+        """Triage + host-side staging + async plan dispatch for up to
+        max_batch requests of one bucket: ``(n_popped, ticket|None)``.
+        Completion bookkeeping runs from the plan's fence callback.
+        Only the queue pop holds the lock — staging and dispatch do
+        not, so concurrent ``submit`` calls never wait on a batch."""
+        with self._lock:
+            n = min(len(bucket.pending), self.options.max_batch)
+            if n == 0:
+                return 0, None
+            self._flushes += 1
+            requests = [bucket.pending.popleft() for _ in range(n)]
         now = self._clock()
         tracing = obs_trace.enabled()
         label = bucket.stats.label
@@ -525,43 +580,50 @@ class SolveService:
             else:
                 live.append(r)
         if not live:
-            return n
+            return n, None
         dispatch_us = obs_trace.now_us() if tracing else 0.0
         for r in live:  # queue wait = submit -> this dispatch instant
             wait_ms = (now - r.submitted_at) * 1e3
             self._queue_wait.record(label, wait_ms)
             bucket.obs_queue_wait.observe(wait_ms)
-        lanes = pad_lanes(len(live), self.options.max_batch)
-        pad = lanes - len(live)
-        plist = [r.params for r in live] + [live[-1].params] * pad
-        batched = jax.tree_util.tree_map(
-            lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *plist)
+        plan = self.plan
+        lanes = plan.lanes_for(len(live), self.options.max_batch)
+        # host-side staging: stack on the host, one transfer per leaf,
+        # placed (and made donation-safe) by the plan; the padded lanes
+        # repeat the last live request's params
+        argnums = bucket.program.donate_argnums
+        batched = plan.stage(
+            plan.stack([r.params for r in live], lanes=lanes),
+            lanes=lanes, donate=0 in argnums)
         if bucket.kind == "ipm":
-            x0_stack = jnp.stack(
-                [jnp.asarray(v) for v in
-                 [r.x0 for r in live] + [live[-1].x0] * pad])
-        mesh = self.options.mesh
-        if mesh is not None and lanes % mesh.size == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
+            x0_stack = plan.stage(
+                plan.stack([r.x0 for r in live], lanes=lanes),
+                lanes=lanes, donate=1 in argnums)
+            args = (batched, x0_stack)
+        else:
+            args = (batched,)
+        ticket = plan.submit(
+            bucket.program, args, n_live=len(live), lanes=lanes,
+            on_done=lambda t: self._complete_batch(
+                bucket, live, lanes, dispatch_us, t.result))
+        return n, ticket
 
-            shard = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
-            batched = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, shard), batched)
-            if bucket.kind == "ipm":
-                x0_stack = jax.device_put(x0_stack, shard)
-        with obs_trace.span("serve.batch", bucket=bucket.stats.label,
-                            lanes=lanes, live=len(live)) as sp:
-            if bucket.kind == "ipm":
-                res = bucket.run(batched, x0_stack)
-            else:
-                res = bucket.run(batched)
-            # sp.fence == jax.block_until_ready, span or no span: batch
-            # latency must cover device completion
-            res = sp.fence(res)
+    def _complete_batch(self, bucket: _Bucket, live: List[SolveHandle],
+                        lanes: int, dispatch_us: float, res) -> None:
+        """Fence-time bookkeeping for one dispatched batch (runs from
+        the plan's ``on_done``, after device completion)."""
+        tracing = obs_trace.enabled()
+        label = bucket.stats.label
         bucket.stats.record_batch(len(live), lanes)
         self._obs_batches.inc(bucket=label)
         end = self._clock()
         end_us = obs_trace.now_us() if tracing else 0.0
+        if tracing:
+            # retroactive counterpart of the old fenced serve.batch
+            # span: the window is dispatch -> fence completion
+            obs_trace.complete(
+                "serve.batch", dispatch_us, end_us - dispatch_us,
+                bucket=label, lanes=lanes, live=len(live))
         objs = np.asarray(res.obj)
         flight_on = obs_flight.enabled()
         conv = None
@@ -617,7 +679,6 @@ class SolveService:
             if bucket.kind == "ipm" and self.options.warm_start:
                 self._warm.put(r.warm_key, bucket.nlp, lane)
         self._obs_solved.inc(len(live))
-        return n
 
     # -- telemetry ---------------------------------------------------------
 

@@ -175,7 +175,18 @@ def make_ipm_solver(
     holds per-iteration ``mu``/``kkt_error``/``alpha``/``stall`` arrays of
     length ``max_iter`` (entries past ``iterations`` repeat the final
     state) — the solver-iteration telemetry the reference gets from
-    idaeslog/solver_log tee output (SURVEY.md §5)."""
+    idaeslog/solver_log tee output (SURVEY.md §5).
+
+    Donation contract (``dispatches_tpu.plan``): the ``x0`` argument is
+    the solver's initial iterate and aliases the returned ``x`` in
+    shape/dtype, so a vmapped ``solve`` may be compiled with
+    ``donate_argnums`` covering the ``x0`` stack — XLA then updates the
+    iterate buffer in place across the batch instead of reallocating.
+    ``params`` has no alias-compatible output and must NOT be donated
+    (it would only raise "donated buffers were not usable" warnings).
+    Donating callers own the staged ``x0`` buffer exclusively
+    (``ExecutionPlan.stage`` guarantees this) — it is deleted by the
+    solve."""
     opts = options or IPMOptions()
     # condensation-matmul precision tier (see IPMOptions.precision);
     # "f32" maps to None so the default policy leaves the jaxpr

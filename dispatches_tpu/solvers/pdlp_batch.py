@@ -332,7 +332,15 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
     ``batched_params`` follows ``nlp.default_params()`` structure; the
     per-scenario (c, b) are derived inside the trace exactly as in
     pdlp.py (one residual eval at x=0 + one objective gradient, vmapped
-    over the batch)."""
+    over the batch).
+
+    Donation contract (``dispatches_tpu.plan``): PDLP starts from the
+    cold x=0/z=0 iterate internally, so the call boundary carries NO
+    alias-compatible batch state — ``batched_params`` leaves do not
+    alias any output, and plan programs over this solver (and over the
+    vmapped per-scenario pdlp.py solver) must use
+    ``donate_argnums=()``.  In-place iterate reuse happens inside the
+    compiled while-loop/Pallas sweep instead."""
     opt = options
     if opt.polish:
         raise NotImplementedError(

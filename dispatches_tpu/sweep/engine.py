@@ -8,11 +8,18 @@ to the serve layer's power-of-two lane menu (``serve.bucket.pad_lanes``
 chunks), executed through one of three interchangeable backends behind
 the same spec:
 
-* ``direct``  — one jitted ``jax.vmap`` of the batched IPM/PDLP kernel;
+* ``direct``  — the chunk staged + dispatched through a
+  :class:`dispatches_tpu.plan.ExecutionPlan` program (one vmapped
+  kernel per lane width; mesh placement when the plan carries one);
 * ``mesh``    — ``parallel.scenario_sharded_solver`` over a device mesh
-  (chunk lanes sharded across chips);
+  (itself a thin ExecutionPlan caller since the plan refactor);
 * ``serve``   — per-point requests through a ``serve.SolveService``
-  (shared with live traffic, or a private warm-start-free instance).
+  (shared with live traffic, or a private warm-start-free instance;
+  the service dispatches through its own plan).
+
+All three therefore route through the ONE execution-plan dispatch
+layer (placement, donation, dispatch-ahead) — the engine keeps chunk
+planning, checkpointing, and quarantine.
 
 Robustness is first-class (MPAX and "Many Problems, One GPU" both treat
 the managed batch, not the single solve, as the unit of work):
@@ -36,11 +43,9 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Mapping, Optional, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
-from dispatches_tpu.analysis.runtime import graft_jit
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
@@ -159,6 +164,7 @@ def run_sweep(nlp, spec: SweepSpec, *,
               base_params=None,
               mesh=None,
               service=None,
+              plan=None,
               on_chunk: Optional[Callable[[int, int], None]] = None,
               ) -> ResultStore:
     """Plan + execute ``spec`` against ``nlp``; returns the (possibly
@@ -169,7 +175,11 @@ def run_sweep(nlp, spec: SweepSpec, *,
     manifest, so a resume with different base params is refused).
     ``on_chunk(cid, n_chunks)`` fires after each chunk is durably
     recorded — an exception from it (or a kill) loses nothing already
-    recorded.
+    recorded.  ``plan`` injects a caller-owned
+    :class:`~dispatches_tpu.plan.ExecutionPlan` into the direct backend
+    (sharing placement/pipeline with other work); None builds one from
+    ``PlanOptions.from_env()`` (``DISPATCHES_TPU_PLAN_*`` flags) with
+    ``mesh`` folded in.
     """
     opts = options if options is not None else SweepOptions.from_env()
     if opts.chunk_size < 1:
@@ -198,11 +208,11 @@ def run_sweep(nlp, spec: SweepSpec, *,
         params_fingerprint=request_fingerprint(defaults))
 
     solve_chunk = _make_backend(nlp, opts, defaults, names_p, names_f,
-                                mesh=mesh, service=service)
+                                mesh=mesh, service=service, plan=plan)
 
-    plan = store.chunk_plan()
+    chunks = store.chunk_plan()
     ran = 0
-    for cid, start, stop in plan:
+    for cid, start, stop in chunks:
         if cid in store.completed:
             continue
         if opts.max_chunks is not None and ran >= opts.max_chunks:
@@ -286,7 +296,7 @@ def run_sweep(nlp, spec: SweepSpec, *,
             extra=_chunk_cost_telemetry(opts, n_live))
         ran += 1
         if on_chunk is not None:
-            on_chunk(cid, len(plan))
+            on_chunk(cid, len(chunks))
     _ledger_record(store, opts, solve_chunk)
     return store
 
@@ -389,39 +399,56 @@ def _ledger_record(store: ResultStore, opts: "SweepOptions",
 
 
 def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
-                  mesh=None, service=None):
+                  mesh=None, service=None, plan=None):
     """``solve_chunk(values, n_live) -> (obj, conv, iters, refined)``
     closure for the configured backend."""
     backend = opts.backend.lower()
     if backend == "direct":
+        from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+        xplan = plan if plan is not None else ExecutionPlan(
+            PlanOptions.from_env(mesh=mesh))
         base, _ = _resolve_solver(nlp, opts.solver, opts.solver_options)
         in_axes = {
             "p": {k: (0 if k in names_p else None) for k in defaults["p"]},
             "fixed": {k: (0 if k in names_f else None)
                       for k in defaults["fixed"]},
         }
-        # graft_jit (not bare jax.jit): chunk widths are shape-stable,
-        # so compile accounting — and, under OBS_PROFILE, per-program
-        # cost cards feeding the report's bytes/point — applies here too
-        vrun = graft_jit(jax.vmap(base, in_axes=(in_axes,)),
-                         label="sweep.direct")
+        # swept leaves carry the lane axis; defaults replicate (the
+        # plan shards/replicates accordingly when it holds a mesh)
+        batched = {
+            "p": {k: k in names_p for k in defaults["p"]},
+            "fixed": {k: k in names_f for k in defaults["fixed"]},
+        }
+        # a plan program (graft_jit, not bare jax.jit): chunk widths are
+        # shape-stable, so compile accounting — and, under OBS_PROFILE,
+        # per-program cost cards feeding the report's bytes/point —
+        # applies here too.  No donation: the chunk kernel takes one
+        # params pytree and carries no alias-compatible iterate state
+        # at the call boundary (donating it would only warn).
+        program = xplan.program(base, label="sweep.direct",
+                                vmap_axes=(in_axes,), donate_argnums=())
 
         def solve_chunk(values, n_live):
-            width = pad_lanes(n_live, opts.chunk_size)
+            width = xplan.lanes_for(n_live, opts.chunk_size)
             padded = _pad_rows(values, width)
-            p = {k: jnp.asarray(v) for k, v in defaults["p"].items()}
-            f = {k: jnp.asarray(v) for k, v in defaults["fixed"].items()}
+            p = dict(defaults["p"])
+            f = dict(defaults["fixed"])
             for k, v in padded.items():
                 if k in p:
-                    p[k] = jnp.asarray(v)
+                    p[k] = v
                 else:
-                    f[k] = jnp.asarray(v)
-            # fence before _extract so the chunk timer upstream measures
-            # device completion, not async dispatch (points/s honesty)
-            return _extract(
-                jax.block_until_ready(vrun({"p": p, "fixed": f})), n_live)
+                    f[k] = v
+            staged = xplan.stage({"p": p, "fixed": f}, lanes=width,
+                                 donate=False, batched=batched)
+            ticket = xplan.submit(program, (staged,),
+                                  n_live=n_live, lanes=width)
+            # collect() fences before _extract so the chunk timer
+            # upstream measures device completion, not async dispatch
+            # (points/s honesty)
+            return _extract(xplan.collect(ticket), n_live)
 
-        solve_chunk._graft_counter = vrun._graft_counter
+        solve_chunk._graft_counter = program._graft_counter
         return solve_chunk
 
     if backend == "mesh":

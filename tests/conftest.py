@@ -17,3 +17,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy XLA-compile tests kept out of the tier-1 fast lane "
+        "(run with -m slow)")

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r07_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r08_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -36,10 +36,15 @@ def test_preview_record_passes_schema(bench):
         assert key in out
     for key in bench.ROOFLINE_KEYS:
         assert key in out["roofline"]
-    # serve section carries the SLO tail metrics (null on records that
-    # predate them, but the keys are part of the contract)
+    # serve section carries the SLO tail metrics — measured (non-null)
+    # since r08: the bench stream carries deadlines now
     for key in bench.SERVE_KEYS:
         assert key in out["serve"]
+    for key in bench.SERVE_NONNULL_KEYS:
+        assert out["serve"][key] is not None
+    # the execution-plan dispatch A/B is pinned from r08 on
+    for key in bench.PLAN_KEYS:
+        assert key in out["plan"]
 
 
 def test_preview_pdlp_variant_ab(bench):
@@ -90,6 +95,34 @@ def test_preview_pdlp_precision_ab(bench):
     assert out["pdlp_precision_resolved"] in ("f32", "bf16x-f32", "f32-f64")
 
 
+def test_preview_plan_ab(bench):
+    """The pinned preview backs the execution-plan acceptance claims:
+    on the 8-device host-CPU mesh, dispatch-ahead staging through the
+    plan beats the legacy per-lane fence-every-batch shape by >= 1.2x
+    solves/s (the win is staging + dispatch overhead — the virtual
+    devices share cores), and the donated-x0 IPM program's cost-card
+    peak bytes per solve stay flat as the dispatched batch count grows
+    (in-place iterate update), with the staged input actually consumed."""
+    out = json.load(open(PREVIEW))
+    plan = out["plan"]
+    assert plan["devices"] == 8
+    assert plan["inflight"] == 2
+    assert plan["sps_ratio_ahead_vs_sync"] >= 1.2
+    ratio = (plan["ahead"]["solves_per_sec"]
+             / plan["sync"]["solves_per_sec"])
+    assert plan["sps_ratio_ahead_vs_sync"] == pytest.approx(ratio, rel=1e-2)
+    # plan host staging is the cheap path: the legacy per-lane device
+    # stacking it replaced dominates the sync arm's per-batch cost
+    assert (plan["ahead"]["stage_ms_per_batch"]
+            < plan["sync"]["stage_ms_per_batch"])
+    donation = plan["donation"]
+    for key in bench.PLAN_DONATION_KEYS:
+        assert key in donation
+    assert donation["x0_donated"] and donation["input_deleted"]
+    assert (donation["peak_bytes_per_solve_k2"]
+            == donation["peak_bytes_per_solve_k8"])
+
+
 def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["vs_baseline"]
@@ -128,13 +161,34 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["pdlp_precision"]
     bench.validate_bench_output(out)
-    # the serve section must carry the SLO tail keys when present
+    # the serve section must carry the SLO tail keys when present, and
+    # (since r08) they must be measured, not null
     out = json.load(open(PREVIEW))
     del out["serve"]["serve_p99_ms"]
     with pytest.raises(ValueError, match="serve_p99_ms"):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
+    out["serve"]["deadline_miss_rate"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
     del out["serve"]
+    bench.validate_bench_output(out)
+    # the plan section is optional-but-complete, arms and donation too
+    out = json.load(open(PREVIEW))
+    del out["plan"]["sps_ratio_ahead_vs_sync"]
+    with pytest.raises(ValueError, match="sps_ratio_ahead_vs_sync"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["plan"]["ahead"]["solves_per_sec"]
+    with pytest.raises(ValueError, match="ahead"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["plan"]["donation"]["input_deleted"]
+    with pytest.raises(ValueError, match="input_deleted"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["plan"]
     bench.validate_bench_output(out)
 
 

@@ -419,7 +419,7 @@ def test_profile_off_serve_hot_path_untouched(monkeypatch):
     assert all(h.result().status == "DONE" for h in handles)
     assert calls == []
     for b in service._buckets.values():
-        assert not isinstance(b.run, profile._ProfiledJit)
+        assert not isinstance(b.program._run, profile._ProfiledJit)
     assert service.metrics()["cost_cards"] == {}
 
 

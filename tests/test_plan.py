@@ -1,0 +1,291 @@
+"""ExecutionPlan layer (``dispatches_tpu.plan``): placement and staging
+policy (host-side fast checks), and slow-lane pipeline tests on the
+virtual 8-device CPU mesh from conftest — uneven-last-batch pad/strip
+through submit/collect, the donation buffer lifecycle (staged input
+consumed, caller-owned arrays protected), and bitwise plan-vs-legacy
+parity for the three former dispatch backends (serve, sweep, parallel):
+each legacy reference is the pre-plan construction — per-lane
+``jnp.stack`` + ``jax.jit(jax.vmap(base))`` (+ explicit ``NamedSharding``
+placement for the mesh path) — so a staging or placement change that
+perturbs results bitwise fails here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu import Flowsheet
+from dispatches_tpu.core.graph import tshift
+from dispatches_tpu.parallel import scenario_mesh
+from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+from dispatches_tpu.solvers import (
+    IPMOptions,
+    PDLPOptions,
+    make_ipm_solver,
+    make_pdlp_solver,
+)
+
+T = 6
+slow = pytest.mark.slow
+
+
+def _storage_nlp(T=T):
+    fs = Flowsheet(horizon=T)
+    fs.add_var("charge", lb=0, ub=1)
+    fs.add_var("discharge", lb=0, ub=1)
+    fs.add_var("soc", lb=0, ub=3)
+    fs.add_var("soc0", shape=(), lb=0)
+    fs.fix("soc0", 0.0)
+    fs.add_param("price", np.ones(T))
+    fs.add_eq(
+        "soc",
+        lambda v, p: v["soc"] - tshift(v["soc"], v["soc0"])
+        - v["charge"] + v["discharge"],
+    )
+    return fs.compile(
+        objective=lambda v, p: jnp.sum(
+            p["price"] * (v["discharge"] - v["charge"])),
+        sense="max",
+    )
+
+
+@pytest.fixture(scope="module")
+def nlp():
+    return _storage_nlp()
+
+
+def _prices(n, rng=None):
+    rng = rng or np.random.default_rng(3)
+    return rng.uniform(1.0, 10.0, (n, T))
+
+
+# ---------------------------------------------------------------------
+# placement + staging policy (host-side, no compiles)
+# ---------------------------------------------------------------------
+
+def test_plan_options_from_env(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_PLAN_INFLIGHT", "5")
+    monkeypatch.setenv("DISPATCHES_TPU_PLAN_DEVICES", "4")
+    opts = PlanOptions.from_env()
+    assert opts.inflight == 5 and opts.devices == 4
+    # explicit overrides win over the environment
+    assert PlanOptions.from_env(inflight=1).inflight == 1
+    monkeypatch.delenv("DISPATCHES_TPU_PLAN_INFLIGHT")
+    monkeypatch.delenv("DISPATCHES_TPU_PLAN_DEVICES")
+    assert PlanOptions.from_env().inflight == 2
+
+
+def test_stack_pads_by_repeating_last():
+    plan = ExecutionPlan(PlanOptions(mesh=None))
+    trees = [{"a": np.full(3, float(i)), "b": float(i)} for i in range(5)]
+    stacked = plan.stack(trees, lanes=8)
+    # host leaves stack on the host: one transfer at stage time
+    assert isinstance(stacked["a"], np.ndarray)
+    assert stacked["a"].shape == (8, 3)
+    for lane in (5, 6, 7):  # padded lanes replay the last live entry
+        np.testing.assert_array_equal(stacked["a"][lane], stacked["a"][4])
+        assert stacked["b"][lane] == stacked["b"][4]
+
+
+def test_stack_device_leaves_stay_on_device():
+    plan = ExecutionPlan(PlanOptions(mesh=None))
+    trees = [{"a": jnp.full(3, float(i))} for i in range(2)]
+    stacked = plan.stack(trees, lanes=2)
+    assert isinstance(stacked["a"], jax.Array)
+
+
+def test_sharding_follows_lane_menu():
+    plan = ExecutionPlan(PlanOptions(mesh=scenario_mesh(8)))
+    assert plan.sharding_for(16) is not None
+    assert plan.sharding_for(12) is None  # not a mesh multiple
+    assert plan.replicated_sharding() is not None
+    solo = ExecutionPlan(PlanOptions(mesh=None))
+    assert solo.sharding_for(16) is None
+    assert solo.replicated_sharding() is None
+    assert plan.lanes_for(5, 8) == 8  # serve bucket menu
+
+
+def test_stage_mixed_mask_shards_and_replicates():
+    plan = ExecutionPlan(PlanOptions(mesh=scenario_mesh(8)))
+    tree = {"a": np.zeros((8, 4)), "b": np.ones(4)}
+    staged = plan.stage(tree, lanes=8, donate=False,
+                        batched={"a": True, "b": False})
+    assert staged["a"].sharding.spec == jax.sharding.PartitionSpec(
+        "scenario")
+    assert staged["b"].sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_stage_donate_copies_caller_owned_arrays():
+    plan = ExecutionPlan(PlanOptions(mesh=None))
+    mine = jnp.arange(8.0)
+    staged = plan.stage({"x": mine}, lanes=8, donate=True)
+    assert staged["x"] is not mine  # plan-owned copy, donation-safe
+    host = np.arange(8.0)
+    staged2 = plan.stage({"x": host}, lanes=8, donate=False)
+    np.testing.assert_array_equal(np.asarray(staged2["x"]), host)
+
+
+# ---------------------------------------------------------------------
+# pipeline: pad/strip, dispatch-ahead window, donation (compiles)
+# ---------------------------------------------------------------------
+
+@slow
+def test_uneven_last_batch_pads_and_strips_on_mesh():
+    """An n_live=5 batch on the 8-device mesh pads to the bucket-menu
+    lane count, runs sharded, and the caller strips the pad; a second
+    uneven width reuses the same compiled program (shape-stable)."""
+    assert len(jax.devices()) == 8
+    plan = ExecutionPlan(PlanOptions(inflight=2, mesh=scenario_mesh(8),
+                                     donate=False))
+    program = plan.program(lambda t: 2.0 * jnp.sum(t["a"]),
+                           label="test.pad", vmap_axes=0,
+                           donate_argnums=())
+
+    def run(n_live):
+        trees = [{"a": np.full(3, float(i + 1))} for i in range(n_live)]
+        lanes = plan.lanes_for(n_live, 8)
+        assert lanes == 8
+        staged = plan.stage(plan.stack(trees, lanes=lanes), lanes=lanes,
+                            donate=False)
+        ticket = plan.submit(program, (staged,), n_live=n_live,
+                             lanes=lanes)
+        full = np.asarray(plan.collect(ticket))
+        assert full.shape == (lanes,)
+        # padded lanes replayed the last live entry...
+        np.testing.assert_array_equal(full[n_live:],
+                                      np.full(lanes - n_live,
+                                              full[n_live - 1]))
+        return full[:n_live]  # ...and are stripped by the caller
+
+    np.testing.assert_array_equal(run(5), 6.0 * np.arange(1.0, 6.0))
+    np.testing.assert_array_equal(run(7), 6.0 * np.arange(1.0, 8.0))
+    assert program.compiles == 1
+
+
+@slow
+def test_dispatch_ahead_window_bounds_inflight():
+    plan = ExecutionPlan(PlanOptions(inflight=2, mesh=None, donate=False))
+    program = plan.program(lambda t: t["a"] + 1.0, label="test.window",
+                           vmap_axes=0, donate_argnums=())
+    tickets = []
+    for i in range(5):
+        staged = plan.stage({"a": np.full(4, float(i))}, lanes=4,
+                            donate=False)
+        tickets.append(plan.submit(program, (staged,), n_live=4, lanes=4))
+        assert plan.inflight <= 2  # submit fences the oldest beyond 2
+    # FIFO completion: the overflowed ones are already fenced
+    assert tickets[0].done() and tickets[1].done() and tickets[2].done()
+    assert plan.drain() == 2 and plan.inflight == 0
+    for i, t in enumerate(tickets):
+        np.testing.assert_array_equal(np.asarray(plan.collect(t)),
+                                      np.full(4, float(i) + 1.0))
+
+
+@slow
+def test_donation_deletes_staged_input_only(nlp):
+    """A donating program consumes the plan-staged x0 stack (buffer
+    deleted -> in-place iterate update) while the non-donated params
+    and any caller-owned source array stay alive."""
+    plan = ExecutionPlan(PlanOptions(inflight=2, mesh=None))
+    base = make_ipm_solver(nlp, IPMOptions(max_iter=8))
+    program = plan.program(base, label="test.donate", vmap_axes=(0, 0),
+                           donate_argnums=(1,))
+    assert program.donates
+    lanes = 4
+    params = plan.stage(plan.stack([nlp.default_params()] * lanes),
+                        lanes=lanes, donate=False)
+    x0_caller = jnp.stack(
+        [jnp.asarray(nlp.x0) * jnp.asarray(nlp.var_scale)] * lanes)
+    x0_staged = plan.stage(x0_caller, lanes=lanes, donate=True)
+    ticket = plan.submit(program, (params, x0_staged), n_live=lanes,
+                         lanes=lanes)
+    res = plan.collect(ticket)
+    assert np.asarray(res.x).shape[0] == lanes
+    assert x0_staged.is_deleted()  # donated to the solve
+    # caller-owned source survives: stage(donate=True) copied it
+    np.testing.assert_array_equal(
+        np.asarray(x0_caller[0]),
+        np.asarray(nlp.x0) * np.asarray(nlp.var_scale))
+    assert not any(jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda a: a.is_deleted(), params)))
+
+
+# ---------------------------------------------------------------------
+# bitwise plan-vs-legacy parity for the three former backends
+# ---------------------------------------------------------------------
+
+def _legacy_stack(trees):
+    """The pre-plan serve staging: one jnp op per lane per leaf."""
+    return jax.tree_util.tree_map(
+        lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *trees)
+
+
+@slow
+def test_serve_parity_bitwise_vs_legacy(nlp):
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    sopts = {"tol": 1e-7, "dtype": "float64"}
+    n = 4
+    plist = [{"p": {**nlp.default_params()["p"], "price": p},
+              "fixed": nlp.default_params()["fixed"]}
+             for p in _prices(n)]
+    svc = SolveService(ServeOptions(max_batch=n, max_wait_ms=1e9,
+                                    warm_start=False))
+    rs = svc.solve_many(nlp, plist, solver="pdlp", options=sopts)
+    legacy = jax.jit(jax.vmap(make_pdlp_solver(nlp, PDLPOptions(**sopts))))
+    ref = np.asarray(legacy(_legacy_stack(plist)).obj)
+    assert [r.obj for r in rs] == [float(o) for o in ref]
+
+
+@slow
+def test_sweep_parity_bitwise_vs_legacy(nlp, tmp_path):
+    from dispatches_tpu.sweep import SweepOptions, SweepSpec, grid, run_sweep
+
+    sopts = {"tol": 1e-7, "dtype": "float64"}
+    rows = _prices(8, np.random.default_rng(9))
+    store = run_sweep(
+        nlp, SweepSpec((grid("price", rows),)),
+        store_dir=tmp_path / "store",
+        options=SweepOptions(chunk_size=8, solver="pdlp",
+                             solver_options=sopts))
+    defaults = nlp.default_params()
+    in_axes = ({"p": {k: (0 if k == "price" else None)
+                      for k in defaults["p"]},
+                "fixed": {k: None for k in defaults["fixed"]}},)
+    legacy = jax.jit(jax.vmap(make_pdlp_solver(nlp, PDLPOptions(**sopts)),
+                              in_axes=in_axes))
+    ref = legacy({"p": {**defaults["p"], "price": rows},
+                  "fixed": defaults["fixed"]})
+    np.testing.assert_array_equal(
+        store.objectives(), np.asarray(ref.obj, dtype=np.float64))
+
+
+@slow
+def test_parallel_parity_bitwise_vs_legacy(nlp):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dispatches_tpu.parallel import scenario_sharded_solver
+
+    mesh = scenario_mesh(8)
+    prices = _prices(16, np.random.default_rng(11))
+    solve = scenario_sharded_solver(nlp, mesh, batched_keys=("price",),
+                                    max_iter=40)
+    objs = np.asarray(solve({"price": prices}))
+
+    # the pre-plan construction: explicit NamedSharding placement
+    base = make_ipm_solver(nlp, IPMOptions(max_iter=40))
+    defaults = nlp.default_params()
+    in_axes = ({"p": {k: (0 if k == "price" else None)
+                      for k in defaults["p"]},
+                "fixed": {k: None for k in defaults["fixed"]}},)
+    legacy = jax.jit(jax.vmap(lambda p: base(p).obj, in_axes=in_axes))
+    sh = NamedSharding(mesh, PartitionSpec("scenario"))
+    repl = NamedSharding(mesh, PartitionSpec())
+    args = {"p": {k: (jax.device_put(jnp.asarray(prices), sh)
+                      if k == "price"
+                      else jax.device_put(jnp.asarray(v), repl))
+                  for k, v in defaults["p"].items()},
+            "fixed": {k: jax.device_put(jnp.asarray(v), repl)
+                      for k, v in defaults["fixed"].items()}}
+    np.testing.assert_array_equal(objs, np.asarray(legacy(args)))
