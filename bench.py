@@ -260,9 +260,18 @@ SERVE_NONNULL_KEYS = ("serve_p99_ms", "deadline_miss_rate")
 #: donated-x0 IPM program's cost card: peak bytes per solve must stay
 #: flat as the number of dispatched batches grows (in-place iterate
 #: update), and the staged x0 input buffer must actually be consumed.
+#: since r09 each arm also carries its measured pipeline timeline
+#: numbers (obs.timeline over the arm's plan lifecycle spans):
+#: ``overlap_efficiency`` must be ~0 for the fence-every-batch sync arm
+#: and substantially positive for dispatch-ahead — the direction is
+#: pinned in tests/test_bench_contract.py and the ahead arm's values
+#: feed the ledger (``overlap_efficiency`` gated, ``plan_stall_pct``
+#: recorded)
 PLAN_KEYS = ("lanes", "batches", "devices", "inflight", "sync", "ahead",
-             "sps_ratio_ahead_vs_sync", "obj_max_abs_diff", "donation")
-PLAN_ARM_KEYS = ("solves_per_sec", "stage_ms_per_batch")
+             "sps_ratio_ahead_vs_sync", "obj_max_abs_diff",
+             "overlap_efficiency", "plan_stall_pct", "donation")
+PLAN_ARM_KEYS = ("solves_per_sec", "stage_ms_per_batch",
+                 "overlap_efficiency", "stall_pct")
 PLAN_DONATION_KEYS = ("lanes", "x0_donated", "input_deleted",
                       "peak_bytes_per_solve_k2", "peak_bytes_per_solve_k8")
 
@@ -366,6 +375,14 @@ def _finalize_output(out):
         # guardrail that catches a precision/refinement regression
         if out.get("obj_rel_err_vs_highs") is not None:
             metrics["obj_rel_err"] = out["obj_rel_err_vs_highs"]
+        # dispatch-ahead pipeline health from the plan A/B timeline:
+        # overlap is gated (higher is better — staging hidden under
+        # device compute must not regress), stall% is recorded
+        plan = out.get("plan") or {}
+        if plan.get("overlap_efficiency") is not None:
+            metrics["overlap_efficiency"] = plan["overlap_efficiency"]
+        if plan.get("plan_stall_pct") is not None:
+            metrics["plan_stall_pct"] = plan["plan_stall_pct"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -789,6 +806,9 @@ def run_bench():
                 lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                 *batch)
 
+        from dispatches_tpu.obs import timeline as obs_timeline
+        from dispatches_tpu.obs import trace as obs_trace
+
         def _run_plan_arm(xplan, label, stage_fn, fence_each):
             program = xplan.program(plan_kernel, label=label,
                                     vmap_axes=0, donate_argnums=())
@@ -796,6 +816,10 @@ def run_bench():
             xplan.collect(xplan.submit(
                 program, (stage_fn(plan_batches_trees[0]),),
                 n_live=plan_lanes, lanes=plan_lanes))
+            # pipeline timeline covers the timed region only: reset the
+            # ring so the warm-up's compile-laden spans can't pollute
+            # the overlap/stall accounting (arms run sequentially)
+            obs_trace.reset()
             stage_s, tickets = 0.0, []
             t0 = time.perf_counter()
             for batch in plan_batches_trees:
@@ -809,20 +833,36 @@ def run_bench():
                 tickets.append(ticket)
             objs = [np.asarray(xplan.collect(t).obj) for t in tickets]
             elapsed = time.perf_counter() - t0
-            return elapsed, stage_s, np.concatenate(objs)
+            tl = obs_timeline.build_timeline(obs_trace.events(),
+                                             plan=xplan.plan_id)
+            return elapsed, stage_s, np.concatenate(objs), tl
 
         sync_plan = ExecutionPlan(PlanOptions(
             inflight=1, mesh=None, donate=False))
         ahead_plan = ExecutionPlan(PlanOptions(
             inflight=2, mesh=scenario_mesh(), donate=False))
-        sync_s, sync_stage_s, sync_obj = _run_plan_arm(
-            sync_plan, "bench.plan.sync", _legacy_stack, fence_each=True)
-        ahead_s, ahead_stage_s, ahead_obj = _run_plan_arm(
-            ahead_plan, "bench.plan.ahead",
-            lambda batch: ahead_plan.stage(
-                ahead_plan.stack(batch, lanes=plan_lanes),
-                lanes=plan_lanes, donate=False),
-            fence_each=False)
+        tracing_was_on = obs_trace.enabled()
+        obs_trace.enable(True)  # both arms, restored below
+        try:
+            sync_s, sync_stage_s, sync_obj, sync_tl = _run_plan_arm(
+                sync_plan, "bench.plan.sync", _legacy_stack,
+                fence_each=True)
+            ahead_s, ahead_stage_s, ahead_obj, ahead_tl = _run_plan_arm(
+                ahead_plan, "bench.plan.ahead",
+                lambda batch: ahead_plan.stage(
+                    ahead_plan.stack(batch, lanes=plan_lanes),
+                    lanes=plan_lanes, donate=False),
+                fence_each=False)
+        finally:
+            obs_trace.enable(tracing_was_on)
+            obs_trace.reset()
+
+        def _arm_timeline(tl):
+            if tl is None:
+                return {"overlap_efficiency": None, "stall_pct": None}
+            return {"overlap_efficiency": tl["overlap_efficiency"],
+                    "stall_pct": tl["stall"]["stall_pct"]}
+
         n_solves = plan_lanes * plan_batches
         out["plan"] = {
             "lanes": plan_lanes,
@@ -833,15 +873,22 @@ def run_bench():
                 "solves_per_sec": round(n_solves / sync_s, 2),
                 "stage_ms_per_batch": round(
                     1e3 * sync_stage_s / plan_batches, 2),
+                **_arm_timeline(sync_tl),
             },
             "ahead": {
                 "solves_per_sec": round(n_solves / ahead_s, 2),
                 "stage_ms_per_batch": round(
                     1e3 * ahead_stage_s / plan_batches, 2),
+                **_arm_timeline(ahead_tl),
             },
             "sps_ratio_ahead_vs_sync": round(sync_s / ahead_s, 3),
             # sharded reductions may reorder; report, don't assert
             "obj_max_abs_diff": float(np.max(np.abs(sync_obj - ahead_obj))),
+            # headline pipeline numbers = the dispatch-ahead arm's (the
+            # production shape); these feed the perf ledger
+            "overlap_efficiency": _arm_timeline(
+                ahead_tl)["overlap_efficiency"],
+            "plan_stall_pct": _arm_timeline(ahead_tl)["stall_pct"],
             "donation": None,
         }
 

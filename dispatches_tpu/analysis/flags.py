@@ -72,6 +72,17 @@ REGISTERED_FLAGS = {
     "PDLP_REFINE_ROUNDS": "override PDLPOptions.refine_rounds, the max "
     "high-tier iterative-refinement epochs appended to a low-precision "
     "PDLP solve (solvers.pdlp.resolve_pdlp_refine_rounds)",
+    "OBS_EXPORT_DIR": "continuous-exporter output directory; setting "
+    "it arms the periodic JSONL time-series + metrics.prom writer that "
+    "SolveService ticks from submit/poll (obs.export; unset = exporter "
+    "disarmed, zero writes)",
+    "OBS_EXPORT_INTERVAL_S": "continuous-exporter seconds between "
+    "interval records on the service clock (obs.export; default 10)",
+    "OBS_EXPORT_MAX_FILES": "continuous-exporter JSONL rotation: files "
+    "kept before the oldest is deleted (obs.export; default 8)",
+    "OBS_EXPORT_MAX_RECORDS": "continuous-exporter JSONL rotation: "
+    "records per file before starting the next (obs.export; default "
+    "1024)",
     "PLAN_INFLIGHT": "execution-plan dispatch-ahead window: max batches "
     "dispatched but not yet fenced (plan.PlanOptions.from_env; default "
     "2, 1 = fully synchronous dispatch)",

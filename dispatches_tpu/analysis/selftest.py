@@ -142,6 +142,7 @@ CORPUS: Dict[str, Dict[str, str]] = {
                 speed = os.environ["DISPATCHES_TPU_LUDICROUS"]
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_TURBO_CHUNK")
             led = os.environ.get("DISPATCHES_TPU_OBS_LEDGER")
+            exp = os.environ.get("DISPATCHES_TPU_OBS_EXPORT")
         """,
         "good": """
             import os
@@ -152,6 +153,10 @@ CORPUS: Dict[str, Dict[str, str]] = {
             led_dir = os.environ.get("DISPATCHES_TPU_OBS_LEDGER_DIR")
             flight = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_DIR")
             slo = os.environ.get("DISPATCHES_TPU_OBS_SLO")
+            exp_dir = os.environ.get("DISPATCHES_TPU_OBS_EXPORT_DIR")
+            exp_int = os.environ.get("DISPATCHES_TPU_OBS_EXPORT_INTERVAL_S")
+            exp_nf = os.environ.get("DISPATCHES_TPU_OBS_EXPORT_MAX_FILES")
+            exp_nr = os.environ.get("DISPATCHES_TPU_OBS_EXPORT_MAX_RECORDS")
             algo = os.environ.get("DISPATCHES_TPU_PDLP_ALGO")
             prec = os.environ.get("DISPATCHES_TPU_PDLP_PRECISION")
             rounds = os.environ.get("DISPATCHES_TPU_PDLP_REFINE_ROUNDS")

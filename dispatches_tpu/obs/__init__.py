@@ -16,7 +16,13 @@ Three pieces, one import surface:
   from registry snapshots (``--slo [--check]``);
 * :mod:`~dispatches_tpu.obs.flight` — triggered flight recorder
   dumping diagnostic bundles on anomalies
-  (``DISPATCHES_TPU_OBS_FLIGHT_DIR``; ``--flight``).
+  (``DISPATCHES_TPU_OBS_FLIGHT_DIR``; ``--flight``);
+* :mod:`~dispatches_tpu.obs.timeline` — per-batch execution-plan
+  pipeline timeline: overlap efficiency, in-flight occupancy, stall
+  attribution (``--timeline``);
+* :mod:`~dispatches_tpu.obs.export` — continuous telemetry export for
+  long-running processes: Prometheus text rendering + periodic JSONL
+  time series (``DISPATCHES_TPU_OBS_EXPORT_DIR``).
 
 Everything here is disabled by default; set ``DISPATCHES_TPU_OBS=1``
 (or call :func:`enable`) to record, and run
@@ -57,4 +63,11 @@ from dispatches_tpu.obs.report import (  # noqa: F401
     request_journey,
     validate_chrome_trace,
 )
-from dispatches_tpu.obs import flight, ledger, profile, slo  # noqa: F401
+from dispatches_tpu.obs import (  # noqa: F401
+    export,
+    flight,
+    ledger,
+    profile,
+    slo,
+    timeline,
+)

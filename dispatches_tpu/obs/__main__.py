@@ -24,6 +24,14 @@
     # flight-recorder bundles (DISPATCHES_TPU_OBS_FLIGHT_DIR)
     python -m dispatches_tpu.obs --flight [--json] [--flight-dir DIR]
 
+    # execution-plan pipeline timeline: overlap efficiency, inflight
+    # occupancy, stall attribution (runs a dispatch-ahead plan demo, or
+    # reconstructs from a saved trace)
+    python -m dispatches_tpu.obs --timeline [--json] [--trace-file PATH]
+
+    # registry as Prometheus text exposition (obs.export)
+    python -m dispatches_tpu.obs --prom
+
 The demo workload is a small batch-serve session (the same battery
 arbitrage LP the serve CLI uses) with obs force-enabled, so the report
 exercises the real instrumentation: serve batch spans, ``graft_jit``
@@ -112,6 +120,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--flight-dir", metavar="DIR", default=None,
                         help="bundle directory (default: the "
                              "DISPATCHES_TPU_OBS_FLIGHT_DIR flag)")
+    parser.add_argument("--timeline", action="store_true",
+                        help="reconstruct the execution-plan pipeline "
+                             "timeline (overlap efficiency, inflight "
+                             "occupancy, stall attribution) from a "
+                             "dispatch-ahead demo run or --trace-file")
+    parser.add_argument("--prom", action="store_true",
+                        help="print the metrics registry as Prometheus "
+                             "text exposition (runs the demo workload "
+                             "when the registry is empty)")
     args = parser.parse_args(argv)
 
     if args.ledger or args.check_regressions:
@@ -120,6 +137,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _slo_main(args)
     if args.flight:
         return _flight_main(args)
+    if args.timeline:
+        return _timeline_main(args)
+    if args.prom:
+        return _prom_main(args)
 
     if not (args.report or args.export_trace):
         parser.print_help()
@@ -135,7 +156,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         snapshot = registry.default_registry().snapshot()
 
     if args.export_trace:
-        n = trace.export_chrome_trace(args.export_trace, events)
+        from dispatches_tpu.obs import timeline as _timeline
+
+        # counter tracks: Perfetto draws the in-flight depth of every
+        # plan in the trace as a lane under the spans
+        merged = list(events) + _timeline.counter_events(events)
+        n = trace.export_chrome_trace(args.export_trace, merged)
         print(f"wrote {n} event(s) to {args.export_trace}", file=sys.stderr)
         if trace.dropped():
             print(f"WARNING: {trace.dropped()} event(s) were evicted from "
@@ -154,6 +180,66 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(report.format_report(events, snapshot,
                                        dropped=trace.dropped()), end="")
+    return 0
+
+
+def _plan_demo_workload() -> None:
+    """Dispatch-ahead plan session under forced tracing: one
+    ExecutionPlan (inflight=2) staging and submitting 6 small batches
+    of a toy iterative kernel back-to-back, then draining — the
+    smallest run that produces a meaningful pipeline timeline."""
+    import numpy as np
+
+    from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+    plan = ExecutionPlan(PlanOptions(inflight=2, mesh=None, donate=False))
+
+    def fn(x):
+        import jax.numpy as jnp
+
+        for _ in range(16):
+            x = jnp.tanh(x) * 1.01 + 0.05
+        return x
+
+    program = plan.program(fn, label="obs.timeline_demo", donate=False)
+    lanes = 8
+    for i in range(6):
+        batch = np.full((lanes, 256), 0.1 * (i + 1), dtype=np.float32)
+        staged = plan.stage(batch, lanes=lanes, donate=False)
+        plan.submit(program, (staged,), n_live=lanes, lanes=lanes)
+    plan.drain()
+
+
+def _timeline_main(args) -> int:
+    from dispatches_tpu.obs import timeline
+
+    if args.trace_file:
+        events = report.load_chrome_trace(args.trace_file)
+    else:
+        trace.enable(True)
+        _plan_demo_workload()
+        events = trace.events()
+    tl = timeline.build_timeline(events)
+    if args.export_trace:
+        merged = list(events) + timeline.counter_events(events)
+        n = trace.export_chrome_trace(args.export_trace, merged)
+        print(f"wrote {n} event(s) to {args.export_trace}",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps({"timeline": tl}, indent=2, sort_keys=True))
+    else:
+        print(timeline.format_timeline(tl), end="")
+    return 0
+
+
+def _prom_main(args) -> int:
+    from dispatches_tpu.obs import export as obs_export
+
+    if not registry.default_registry().metrics():
+        # cold process: populate the registry with a real (small) run
+        trace.enable(True)
+        _demo_workload()
+    sys.stdout.write(obs_export.render_prometheus())
     return 0
 
 

@@ -16,6 +16,11 @@ self-contained JSON bundle:
   module loaded),
 * the triggering request's context — ``request_id``, bucket, params
   fingerprint, solver options — as passed by the hook,
+* a ``plan`` section: execution-plan pipeline state at trigger time —
+  the ``plan.inflight`` / ``serve.queue_depth`` gauges and the last
+  :data:`PLAN_TAIL_EVENTS` plan lifecycle spans from the trace ring —
+  so a deadline-miss bundle shows whether the pipeline was saturated
+  or starved when the request expired,
 * the latest AOT cost card for the triggering kernel label (when
   ``obs.profile`` is on) and a solverlog convergence tail (when the
   caller holds one, i.e. the solver was built with ``trace=True``).
@@ -61,6 +66,7 @@ __all__ = [
 SCHEMA_VERSION = 1
 MAX_BUNDLES = 64       # directory bound: oldest bundles deleted
 TAIL_EVENTS = 256      # trace-ring tail length per bundle
+PLAN_TAIL_EVENTS = 32  # plan-lifecycle tail length in the plan section
 
 #: the trigger vocabulary the serve/sweep/runtime hooks use; free-form
 #: kinds are accepted (the recorder is a sink, not a registry)
@@ -149,6 +155,7 @@ def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
         _last_snapshot = snapshot
         seq = next(_seq)
     tail = _trace.to_chrome_events(_trace.events()[-TAIL_EVENTS:])
+    plan_section = _plan_section(snapshot, _trace.events())
     cost_card = None
     if label is not None:
         try:
@@ -174,6 +181,7 @@ def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
         },
         "trace_tail": tail,
         "trace_dropped": _trace.dropped(),
+        "plan": plan_section,
         "metrics": snapshot,
         "metrics_diff": diff,
         "cost_card": cost_card,
@@ -194,6 +202,29 @@ def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
     except Exception:
         pass
     return path
+
+
+def _plan_section(snapshot: Dict, events: List[Dict]) -> Dict:
+    """Pipeline state at trigger time: the inflight/queue-depth gauges
+    from the registry snapshot plus the last plan lifecycle spans from
+    the trace ring (empty tail when tracing is off)."""
+    from dispatches_tpu.obs.timeline import PLAN_SPAN_NAMES
+
+    def _gauge(name: str):
+        entry = snapshot.get(name)
+        if not entry or entry.get("kind") != "gauge":
+            return None
+        values = entry.get("values") or {}
+        # both gauges are unlabeled: one series under the "" key
+        return values.get("", next(iter(values.values()), None))
+
+    tail = [e for e in events
+            if e.get("ph") == "X" and e.get("name") in PLAN_SPAN_NAMES]
+    return {
+        "inflight": _gauge("plan.inflight"),
+        "queue_depth": _gauge("serve.queue_depth"),
+        "timeline_tail": tail[-PLAN_TAIL_EVENTS:],
+    }
 
 
 def _bundle_paths(directory: str) -> List[str]:

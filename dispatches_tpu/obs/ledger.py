@@ -88,6 +88,14 @@ GATED_METRICS = {
     # is judged against, so regressions gate like throughput does
     "serve_p99_ms": -1,
     "deadline_miss_rate": -1,
+    # dispatch-ahead pipeline health from the bench plan A/B timeline
+    # (obs.timeline): the fraction of host stage/dispatch wall time
+    # hidden under in-flight device work.  Higher is better — a drop
+    # means the pipeline stopped running ahead (the ISSUE-9 win
+    # silently reverting).  ``plan_stall_pct`` rides along ungated:
+    # its fence-bound component grows with device utilisation, so a
+    # one-sided gate would misfire.
+    "overlap_efficiency": +1,
 }
 
 _GIT_SHA: Optional[str] = None

@@ -36,15 +36,27 @@ One plan = one placement policy plus one dispatch pipeline:
   fence.  The ``plan.inflight`` gauge and retroactive ``plan.dispatch``
   spans expose the pipeline to ``dispatches_tpu.obs``.
 
+When tracing is enabled the plan also emits the batch **lifecycle
+timeline** — retroactive ``plan.stage`` / ``plan.submit`` /
+``plan.fence`` spans, each stamped with this plan's id and the batch's
+per-plan sequence number (and the serve ``request_ids`` riding the
+batch, when the caller passes them) — from which
+``dispatches_tpu.obs.timeline`` reconstructs overlap efficiency,
+in-flight occupancy, and stall attribution per pipeline.  Disabled,
+every emission site is behind the one cached ``obs_trace.enabled()``
+boolean, so the hot path pays nothing (the spy-pinned contract in
+``tests/test_timeline_export.py``).
+
 See ``docs/execution_plan.md`` for the lifecycle and donation rules.
 """
 
 from __future__ import annotations
 
+import itertools
 import os
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Deque, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -128,15 +140,22 @@ class PlanProgram:
 
 
 class PlanTicket:
-    """One dispatched batch: a future fenced by ``collect``/``drain``."""
+    """One dispatched batch: a future fenced by ``collect``/``drain``.
 
-    __slots__ = ("label", "lanes", "n_live", "result", "_raw", "_done",
-                 "_on_done", "_t_dispatch_us")
+    ``seq`` is the batch's per-plan sequence number and ``request_ids``
+    the serve request ids riding it — both stamped on the lifecycle
+    spans so a request's journey joins the batch that executed it."""
 
-    def __init__(self, label: str, lanes: int, n_live: int, on_done):
+    __slots__ = ("label", "lanes", "n_live", "seq", "request_ids",
+                 "result", "_raw", "_done", "_on_done", "_t_dispatch_us")
+
+    def __init__(self, label: str, lanes: int, n_live: int, on_done,
+                 seq: int = 0, request_ids: Optional[List[int]] = None):
         self.label = label
         self.lanes = lanes
         self.n_live = n_live
+        self.seq = seq
+        self.request_ids = request_ids
         self.result = None
         self._raw = None
         self._done = False
@@ -157,6 +176,12 @@ def _stack_leaves(leaves: Sequence) -> Any:
     if any(isinstance(leaf, jax.Array) for leaf in leaves):
         return jnp.stack([jnp.asarray(leaf) for leaf in leaves])
     return np.stack([np.asarray(leaf) for leaf in leaves])
+
+
+# process-wide plan ids: every ExecutionPlan stamps its id on the
+# lifecycle spans it emits, so obs.timeline can reconstruct one
+# pipeline (one plan) out of a trace that interleaves several
+_plan_ids = itertools.count(1)
 
 
 class ExecutionPlan:
@@ -184,6 +209,8 @@ class ExecutionPlan:
             mesh = scenario_mesh(self.options.devices,
                                  axis=self.options.axis)
         self.mesh = mesh
+        self.plan_id = next(_plan_ids)
+        self._seq = itertools.count(1)
         self._window: Deque[PlanTicket] = deque()
         self._gauge = obs_registry.gauge(
             "plan.inflight",
@@ -254,6 +281,8 @@ class ExecutionPlan:
         leaf that is already a caller-owned ``jax.Array`` is copied, so
         a donating program can never delete a buffer the caller still
         holds."""
+        tracing = obs_trace.enabled()
+        t0_us = obs_trace.now_us() if tracing else 0.0
         shard = self.sharding_for(lanes)
         repl = self.replicated_sharding()
 
@@ -267,13 +296,22 @@ class ExecutionPlan:
             return arr
 
         if batched is True or batched is False:
-            return jax.tree_util.tree_map(
+            staged = jax.tree_util.tree_map(
                 lambda leaf: place(leaf, batched), tree)
-        # mixed trees: ``batched`` is a matching pytree of plain bools
-        # (True = lane axis, False = replicated; bools, not vmap axes,
-        # because None is not a pytree leaf)
-        return jax.tree_util.tree_map(
-            lambda leaf, b: place(leaf, bool(b)), tree, batched)
+        else:
+            # mixed trees: ``batched`` is a matching pytree of plain
+            # bools (True = lane axis, False = replicated; bools, not
+            # vmap axes, because None is not a pytree leaf)
+            staged = jax.tree_util.tree_map(
+                lambda leaf, b: place(leaf, bool(b)), tree, batched)
+        if tracing:
+            # host staging is the wall time dispatch-ahead exists to
+            # hide; the timeline scores how much of it overlapped an
+            # in-flight batch of this plan
+            obs_trace.complete("plan.stage", t0_us,
+                               obs_trace.now_us() - t0_us,
+                               plan=self.plan_id, lanes=lanes)
+        return staged
 
     # -- programs ----------------------------------------------------------
 
@@ -297,17 +335,32 @@ class ExecutionPlan:
     def submit(self, program: PlanProgram, args: Tuple, *,
                n_live: int, lanes: int,
                on_done: Optional[Callable[[PlanTicket], None]] = None,
-               ) -> PlanTicket:
+               request_ids: Optional[List[int]] = None) -> PlanTicket:
         """Dispatch one staged batch asynchronously.
 
         Returns immediately with a ticket; when the in-flight window is
         full the OLDEST batch is fenced first (continuous batching: a
         freed slot is what admits the next dispatch).  ``on_done`` runs
-        at fence time with the completed ticket."""
-        ticket = PlanTicket(program.label, lanes, n_live, on_done)
-        ticket._t_dispatch_us = obs_trace.now_us() if obs_trace.enabled() else 0.0
+        at fence time with the completed ticket.  ``request_ids``
+        (serve) ride the ticket onto its ``plan.submit`` /
+        ``plan.dispatch`` spans, joining each request's journey to the
+        batch that executed it."""
+        tracing = obs_trace.enabled()
+        ticket = PlanTicket(program.label, lanes, n_live, on_done,
+                            seq=next(self._seq), request_ids=request_ids)
+        ticket._t_dispatch_us = obs_trace.now_us() if tracing else 0.0
         ticket._raw = program._run(*args)
         self._window.append(ticket)
+        if tracing:
+            # host dispatch cost only: _run returned, nothing fenced yet
+            end_us = obs_trace.now_us()
+            args_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                           label=ticket.label, lanes=lanes, live=n_live,
+                           inflight=len(self._window))
+            if request_ids is not None:
+                args_kw["request_ids"] = list(request_ids)
+            obs_trace.complete("plan.submit", ticket._t_dispatch_us,
+                               end_us - ticket._t_dispatch_us, **args_kw)
         self._obs_batches.inc(label=program.label)
         self._gauge.set(float(len(self._window)))
         window = max(int(self.options.inflight), 1)
@@ -336,17 +389,29 @@ class ExecutionPlan:
 
     def _complete_oldest(self) -> PlanTicket:
         ticket = self._window.popleft()
+        tracing = obs_trace.enabled()
+        t_fence_us = obs_trace.now_us() if tracing else 0.0
         ticket.result = jax.block_until_ready(ticket._raw)
         ticket._raw = None
         ticket._done = True
         self._gauge.set(float(len(self._window)))
-        if obs_trace.enabled():
+        if tracing:
             end_us = obs_trace.now_us()
+            # the fence span is the host's wait on the device; the
+            # dispatch span is the batch's full submit -> done window
+            obs_trace.complete(
+                "plan.fence", t_fence_us, end_us - t_fence_us,
+                plan=self.plan_id, seq=ticket.seq, label=ticket.label,
+                lanes=ticket.lanes, inflight=len(self._window))
+            args_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                           label=ticket.label, lanes=ticket.lanes,
+                           live=ticket.n_live,
+                           inflight=len(self._window))
+            if ticket.request_ids is not None:
+                args_kw["request_ids"] = list(ticket.request_ids)
             obs_trace.complete(
                 "plan.dispatch", ticket._t_dispatch_us,
-                end_us - ticket._t_dispatch_us, label=ticket.label,
-                lanes=ticket.lanes, live=ticket.n_live,
-                inflight=len(self._window))
+                end_us - ticket._t_dispatch_us, **args_kw)
         if ticket._on_done is not None:
             ticket._on_done(ticket)
         return ticket

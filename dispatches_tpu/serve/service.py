@@ -58,6 +58,7 @@ import jax
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.obs import export as obs_export
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
@@ -370,6 +371,26 @@ class SolveService:
             "requests (event=met|missed)")
         self._obs_deadline_met = _deadline.labeled(event="met")
         self._obs_deadline_missed = _deadline.labeled(event="missed")
+        self._obs_queue_depth = obs_registry.gauge(
+            "serve.queue_depth", "solve-service pending requests across "
+            "all buckets (flight bundles snapshot it at trigger time)")
+        self._obs_queue_depth.set(0.0)
+        # continuous export (obs.export): armed by OBS_EXPORT_DIR and
+        # ticked from submit/poll on the service's own clock — disarmed,
+        # the hot path pays one `is None` check
+        self._exporter = None
+        if obs_export.enabled():
+            try:
+                self._exporter = obs_export.ContinuousExporter(
+                    clock=self._clock)
+            except Exception:
+                self._exporter = None
+
+    def attach_exporter(self, exporter) -> None:
+        """Attach a caller-built :class:`obs.export.ContinuousExporter`
+        (tests pass one on an injectable clock; production arms via
+        ``DISPATCHES_TPU_OBS_EXPORT_DIR`` at construction)."""
+        self._exporter = exporter
 
     # -- bucket resolution -------------------------------------------------
 
@@ -456,8 +477,11 @@ class SolveService:
             bucket.stats.record_submitted()
             self._submitted += 1
         self._obs_submitted.inc()
+        self._obs_queue_depth.set(float(self._queue_depth()))
         if len(bucket.pending) >= self.options.max_batch:
             self._flush_bucket(bucket)
+        if self._exporter is not None:
+            self._exporter.maybe_export(self._clock())
         return handle
 
     def solve(self, nlp, params=None, x0=None, **submit_kw):
@@ -492,6 +516,8 @@ class SolveService:
             while bucket.pending and (
                     now - bucket.pending[0].submitted_at >= wait_s):
                 n += self._flush_bucket(bucket)
+        if self._exporter is not None:
+            self._exporter.maybe_export(now)
         return n
 
     def flush_all(self) -> int:
@@ -548,6 +574,7 @@ class SolveService:
                 return 0, None
             self._flushes += 1
             requests = [bucket.pending.popleft() for _ in range(n)]
+        self._obs_queue_depth.set(float(self._queue_depth()))
         now = self._clock()
         tracing = obs_trace.enabled()
         label = bucket.stats.label
@@ -605,7 +632,11 @@ class SolveService:
         ticket = plan.submit(
             bucket.program, args, n_live=len(live), lanes=lanes,
             on_done=lambda t: self._complete_batch(
-                bucket, live, lanes, dispatch_us, t.result))
+                bucket, live, lanes, dispatch_us, t.result),
+            # request ids ride the plan lifecycle spans so a request's
+            # journey joins the batch that executed it (obs.timeline)
+            request_ids=([r.request_id for r in live] if tracing
+                         else None))
         return n, ticket
 
     def _complete_batch(self, bucket: _Bucket, live: List[SolveHandle],

@@ -222,7 +222,8 @@ def run_sweep(nlp, spec: SweepSpec, *,
         n_live = len(idxs)
         t0 = time.perf_counter()
         with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
-            obj, conv, iters, refined = solve_chunk(values, n_live)
+            obj, conv, iters, refined = solve_chunk(
+                values, n_live, point_ids=[int(i) for i in idxs])
             # serve backend: the service request ids of this chunk's
             # points, so the quarantine path names the same id the
             # serve.request trace spans carry
@@ -235,7 +236,8 @@ def run_sweep(nlp, spec: SweepSpec, *,
                 for attempt in range(1, opts.max_retries + 1):
                     single = {k: np.asarray(v)[j:j + 1]
                               for k, v in values.items()}
-                    o1, c1, i1, r1 = solve_chunk(single, 1)
+                    o1, c1, i1, r1 = solve_chunk(
+                        single, 1, point_ids=[int(idxs[j])])
                     retry_rids = getattr(solve_chunk, "last_request_ids",
                                          None)
                     if retry_rids:
@@ -400,8 +402,14 @@ def _ledger_record(store: ResultStore, opts: "SweepOptions",
 
 def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                   mesh=None, service=None, plan=None):
-    """``solve_chunk(values, n_live) -> (obj, conv, iters, refined)``
-    closure for the configured backend."""
+    """``solve_chunk(values, n_live, point_ids=None) -> (obj, conv,
+    iters, refined)`` closure for the configured backend.
+
+    ``point_ids`` (the chunk's global point indices) ride the direct
+    backend's plan dispatch as ``request_ids``, so the plan timeline
+    names the points a batch carried the same way serve batches name
+    their request ids; the other backends accept and ignore them (the
+    serve backend mints real service request ids instead)."""
     backend = opts.backend.lower()
     if backend == "direct":
         from dispatches_tpu.plan import ExecutionPlan, PlanOptions
@@ -429,7 +437,7 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
         program = xplan.program(base, label="sweep.direct",
                                 vmap_axes=(in_axes,), donate_argnums=())
 
-        def solve_chunk(values, n_live):
+        def solve_chunk(values, n_live, point_ids=None):
             width = xplan.lanes_for(n_live, opts.chunk_size)
             padded = _pad_rows(values, width)
             p = dict(defaults["p"])
@@ -441,8 +449,9 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                     f[k] = v
             staged = xplan.stage({"p": p, "fixed": f}, lanes=width,
                                  donate=False, batched=batched)
-            ticket = xplan.submit(program, (staged,),
-                                  n_live=n_live, lanes=width)
+            ticket = xplan.submit(
+                program, (staged,), n_live=n_live, lanes=width,
+                request_ids=(point_ids if obs_trace.enabled() else None))
             # collect() fences before _extract so the chunk timer
             # upstream measures device completion, not async dispatch
             # (points/s honesty)
@@ -464,7 +473,7 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             nlp, mesh, batched_keys=names_p, batched_fixed_keys=names_f,
             solver=base, full_result=True)
 
-        def solve_chunk(values, n_live):
+        def solve_chunk(values, n_live, point_ids=None):
             # the sharded solver pads to the mesh and strips internally;
             # fence for the same timing honesty as the direct backend
             return _extract(jax.block_until_ready(sharded(values)), n_live)
@@ -488,7 +497,7 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
         solver_kw = dict(solver=str(opts.solver),
                          options=dict(opts.solver_options or {}))
 
-        def solve_chunk(values, n_live):
+        def solve_chunk(values, n_live, point_ids=None):
             from dispatches_tpu.serve import RequestStatus
 
             plist = []

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r08_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r09_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -123,6 +123,29 @@ def test_preview_plan_ab(bench):
             == donation["peak_bytes_per_solve_k8"])
 
 
+def test_preview_plan_timeline_overlap_direction(bench):
+    """The ISSUE-10 acceptance direction, pinned on the measured
+    preview: the fence-every-batch sync arm hides (essentially) none
+    of its host staging under device work, while dispatch-ahead hides
+    most of it — and the ahead arm's numbers are promoted to the
+    section top level, where _finalize_output feeds the ledger
+    (``overlap_efficiency`` gated upward, ``plan_stall_pct``
+    recorded)."""
+    out = json.load(open(PREVIEW))
+    plan = out["plan"]
+    for arm in ("sync", "ahead"):
+        for key in bench.PLAN_ARM_KEYS:
+            assert key in plan[arm], (arm, key)
+    assert plan["sync"]["overlap_efficiency"] <= 0.05
+    assert plan["ahead"]["overlap_efficiency"] >= 0.2
+    assert plan["overlap_efficiency"] == plan["ahead"]["overlap_efficiency"]
+    assert plan["plan_stall_pct"] == plan["ahead"]["stall_pct"]
+    assert 0.0 <= plan["plan_stall_pct"] <= 100.0
+    # stall attribution shifts with the shape: the sync arm's wall is
+    # almost all stall (every batch fully fenced before the next)
+    assert plan["sync"]["stall_pct"] > plan["ahead"]["stall_pct"]
+
+
 def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["vs_baseline"]
@@ -186,6 +209,15 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["plan"]["donation"]["input_deleted"]
     with pytest.raises(ValueError, match="input_deleted"):
+        bench.validate_bench_output(out)
+    # the r09 timeline keys are part of the plan contract now
+    out = json.load(open(PREVIEW))
+    del out["plan"]["overlap_efficiency"]
+    with pytest.raises(ValueError, match="overlap_efficiency"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["plan"]["sync"]["stall_pct"]
+    with pytest.raises(ValueError, match="sync"):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["plan"]

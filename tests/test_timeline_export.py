@@ -1,0 +1,564 @@
+"""Pipeline-timeline + continuous-export tests (ISSUE 10).
+
+Four subsystems under one roof because they share a contract surface:
+
+* ``obs.timeline`` math on synthetic plan lifecycle events (overlap
+  efficiency, occupancy, stall attribution, counter tracks) — the
+  numbers are hand-computed in the test bodies;
+* the plan/serve integration: lifecycle spans carry plan ids, seqs,
+  and serve ``request_id``s, and the disabled path is spy-pinned to
+  zero tracer calls;
+* ``obs.export``: Prometheus text rendering (escaping, deterministic
+  ordering, byte-stable golden) and the interval JSONL writer on an
+  injectable clock (baseline + interval records, rotation, the
+  ``SolveService`` attachment);
+* registry ``_Window`` quantile semantics at the window-wrap boundary
+  (cumulative count/mean vs windowed quantiles);
+* flight bundles' ``plan`` section (pipeline state at trigger time).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.obs import export as obs_export
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import registry as reg
+from dispatches_tpu.obs import timeline as obs_timeline
+from dispatches_tpu.obs import trace
+
+PROM_GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                           "prometheus_golden.prom")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.enable(False)
+    trace.reset()
+    yield
+    trace.enable(False)
+    trace.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "tid": 1, "args": args}
+
+
+def _pipelined_events(plan=7):
+    """Hand-built dispatch-ahead shape: two batches, the second staged
+    while the first is in flight.  All numbers are round on purpose —
+    every derived metric below is computed by hand from these spans."""
+    return [
+        _span("plan.stage", 0, 10, plan=plan, lanes=4),
+        _span("plan.submit", 10, 5, plan=plan, seq=1, label="k", lanes=4,
+              live=4, inflight=1),
+        _span("plan.stage", 15, 10, plan=plan, lanes=4),
+        _span("plan.submit", 25, 5, plan=plan, seq=2, label="k", lanes=4,
+              live=3, inflight=2, request_ids=[11, 12, 13]),
+        _span("plan.fence", 40, 10, plan=plan, seq=1, label="k", lanes=4,
+              inflight=1),
+        _span("plan.fence", 50, 5, plan=plan, seq=2, label="k", lanes=4,
+              inflight=0),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# timeline math on synthetic events
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_overlap_occupancy_stall_by_hand():
+    tl = obs_timeline.build_timeline(_pipelined_events())
+    assert tl is not None and tl["plan"] == 7
+    assert tl["n_batches"] == 2
+    # wall: t_lo=0 (first stage), t_hi=55 (last fence end)
+    assert tl["wall_us"] == 55.0
+    # host spans stage(0,10)+(15,25) and submit(10,15)+(25,30) coalesce
+    # to [0,30]; in-flight spans [15,50]+[30,55] merge to [15,55];
+    # hidden host time = [15,30] = 15 of 30
+    assert tl["host_us"] == 30.0
+    assert tl["hidden_host_us"] == 15.0
+    assert tl["overlap_efficiency"] == pytest.approx(0.5)
+    # depth steps: +1@15, +1@30, -1@50, -1@55 -> 15us at depth 0,
+    # 15+5us at depth 1, 20us at depth 2
+    assert tl["occupancy"] == {
+        0: pytest.approx(15 / 55, abs=1e-4),
+        1: pytest.approx(20 / 55, abs=1e-4),
+        2: pytest.approx(20 / 55, abs=1e-4),
+    }
+    assert tl["occupancy_mean"] == pytest.approx(60 / 55, abs=1e-3)
+    # stalls: fences 10+5; the only zero-depth window [0,15] is fully
+    # host-covered, so it attributes to host-stage-bound, not starvation
+    st = tl["stall"]
+    assert st["fence_bound_us"] == 15.0
+    assert st["host_stage_bound_us"] == 15.0
+    assert st["queue_empty_us"] == 0.0
+    assert st["stall_pct"] == pytest.approx(100.0 * 30 / 55, abs=0.01)
+
+
+def test_timeline_batches_carry_args_and_request_ids():
+    tl = obs_timeline.build_timeline(_pipelined_events())
+    b1, b2 = tl["batches"]
+    assert (b1["seq"], b1["live"], b1["request_ids"]) == (1, 4, None)
+    assert b2["request_ids"] == [11, 12, 13]
+    assert b1["submit_us"] == 10.0 and b1["dispatched_us"] == 15.0
+    assert b1["fence_end_us"] == 50.0 and b1["fence_wait_us"] == 10.0
+    assert b1["span_us"] == 40.0
+    assert b2["inflight_after_submit"] == 2
+
+
+def test_timeline_sync_shape_scores_zero_overlap():
+    """Fence-every-batch (the bench sync arm): no host span overlaps an
+    in-flight window, so overlap efficiency is exactly 0 and the wall
+    is fence-bound — the direction test_bench_contract.py pins on the
+    measured preview."""
+    events = [
+        _span("plan.submit", 0, 10, plan=1, seq=1, label="s", lanes=2,
+              live=2, inflight=1),
+        _span("plan.fence", 10, 30, plan=1, seq=1, label="s", lanes=2,
+              inflight=0),
+        _span("plan.submit", 40, 10, plan=1, seq=2, label="s", lanes=2,
+              live=2, inflight=1),
+        _span("plan.fence", 50, 30, plan=1, seq=2, label="s", lanes=2,
+              inflight=0),
+    ]
+    tl = obs_timeline.build_timeline(events)
+    assert tl["overlap_efficiency"] == 0.0
+    assert tl["stall"]["fence_bound_us"] == 60.0
+    assert tl["stall"]["queue_empty_us"] == 0.0
+    assert tl["occupancy"][1] == pytest.approx(0.75)
+
+
+def test_timeline_unfenced_batch_counts_to_window_end():
+    events = [
+        _span("plan.submit", 0, 5, plan=3, seq=1, label="u", lanes=1,
+              live=1, inflight=1),
+        _span("plan.stage", 5, 20, plan=3, lanes=1),
+    ]
+    tl = obs_timeline.build_timeline(events)
+    b = tl["batches"][0]
+    assert b["fence_end_us"] is None and b["fence_wait_us"] is None
+    assert b["span_us"] == 25.0  # to t_hi
+    assert tl["overlap_efficiency"] == pytest.approx(20 / 25)
+
+
+def test_timeline_separates_interleaved_plans():
+    events = (_pipelined_events(plan=7)
+              + [_span("plan.submit", 100, 5, plan=9, seq=1, label="z",
+                       lanes=1, live=1, inflight=1),
+                 _span("plan.fence", 105, 5, plan=9, seq=1, label="z",
+                       lanes=1, inflight=0)])
+    assert obs_timeline.plan_ids(events) == [7, 9]
+    # default pick: the plan with the most submitted batches
+    assert obs_timeline.build_timeline(events)["plan"] == 7
+    both = obs_timeline.build_timelines(events)
+    assert set(both) == {7, 9}
+    assert both[9]["n_batches"] == 1
+    # a plan filter never leaks the other pipeline's spans
+    assert both[7]["wall_us"] == 55.0
+
+
+def test_timeline_none_without_plan_events():
+    assert obs_timeline.build_timeline([]) is None
+    assert obs_timeline.build_timeline(
+        [_span("serve.batch", 0, 5, bucket="x")]) is None
+    msg = obs_timeline.format_timeline(None)
+    assert "no plan lifecycle events" in msg
+
+
+def test_counter_events_track_inflight_depth():
+    evts = obs_timeline.counter_events(_pipelined_events())
+    assert [(e["ts"], e["args"]["inflight"]) for e in evts] == [
+        (15.0, 1), (30.0, 2), (50.0, 1), (55.0, 0)]
+    assert all(e["ph"] == "C" for e in evts)
+    assert evts[0]["name"] == "plan.inflight#7"
+    # counter events ride the existing Chrome export unchanged
+    from dispatches_tpu.obs import report
+    report.validate_chrome_trace(trace.to_chrome_events(
+        _pipelined_events() + evts))
+
+
+def test_format_timeline_renders_key_numbers():
+    text = obs_timeline.format_timeline(
+        obs_timeline.build_timeline(_pipelined_events()))
+    assert "overlap efficiency: 0.500" in text
+    assert "depth 2:" in text
+    assert "requests [11, 12, 13]" in text
+
+
+# ---------------------------------------------------------------------------
+# plan integration: lifecycle spans from a real ExecutionPlan
+# ---------------------------------------------------------------------------
+
+
+def _drive_plan(n_batches=3, inflight=2):
+    from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+    plan = ExecutionPlan(PlanOptions(inflight=inflight, mesh=None,
+                                     donate=False))
+    program = plan.program(lambda x: x + 1.0, label="tl.test",
+                           donate=False)
+    for _ in range(n_batches):
+        staged = plan.stage(np.zeros((4, 8), np.float32), lanes=4,
+                            donate=False)
+        plan.submit(program, (staged,), n_live=4, lanes=4)
+    plan.drain()
+    return plan
+
+
+def test_plan_emits_lifecycle_spans_with_plan_id_and_seq():
+    trace.enable(True)
+    plan = _drive_plan(n_batches=3)
+    events = trace.events()
+    names = [e["name"] for e in events]
+    assert names.count("plan.stage") == 3
+    assert names.count("plan.submit") == 3
+    assert names.count("plan.fence") == 3
+    subs = [e for e in events if e["name"] == "plan.submit"]
+    assert [e["args"]["seq"] for e in subs] == [1, 2, 3]
+    assert all(e["args"]["plan"] == plan.plan_id for e in subs)
+    tl = obs_timeline.build_timeline(events, plan=plan.plan_id)
+    assert tl["n_batches"] == 3
+    assert all(b["fence_end_us"] is not None for b in tl["batches"])
+
+
+def test_plan_disabled_is_spy_pinned_to_zero_tracer_calls(monkeypatch):
+    """The whole timeline feature must cost nothing when tracing is
+    off: no retroactive span, no timestamp read, on the plan hot
+    path."""
+    calls = []
+    monkeypatch.setattr(trace, "complete",
+                        lambda *a, **k: calls.append(("complete", a)))
+    monkeypatch.setattr(trace, "now_us",
+                        lambda: calls.append(("now_us",)) or 0.0)
+    _drive_plan(n_batches=2)
+    assert calls == []
+
+
+def test_serve_request_ids_ride_plan_spans():
+    """Satellite: the PR-8 request journey joins the batch that
+    executed it — serve request_ids appear on the plan.submit and
+    plan.dispatch spans and in the reconstructed timeline."""
+    jnp = pytest.importorskip("jax.numpy")
+    from tests.test_serve import _arbitrage_nlp, _toy_base_solver
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    trace.enable(True)
+    service = SolveService(ServeOptions(max_batch=8, max_wait_ms=1e9))
+    nlp = _arbitrage_nlp(4)
+    handles = [service.submit(nlp, base_solver=_toy_base_solver)
+               for _ in range(2)]
+    service.flush_all()
+    for h in handles:
+        h.result()
+    events = trace.events()
+    ids = [h.request_id for h in handles]
+    subs = [e for e in events if e["name"] == "plan.submit"]
+    assert subs and subs[0]["args"]["request_ids"] == ids
+    disp = [e for e in events if e["name"] == "plan.dispatch"]
+    assert disp and disp[0]["args"]["request_ids"] == ids
+    tl = obs_timeline.build_timeline(events, plan=service.plan.plan_id)
+    assert tl["batches"][0]["request_ids"] == ids
+
+
+def test_serve_queue_depth_gauge_tracks_pending():
+    from tests.test_serve import _arbitrage_nlp, _toy_base_solver
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    service = SolveService(ServeOptions(max_batch=64, max_wait_ms=1e9))
+    g = reg.gauge("serve.queue_depth")
+    nlp = _arbitrage_nlp(4)
+    service.submit(nlp, base_solver=_toy_base_solver)
+    assert g.value() == 1.0
+    service.flush_all()
+    assert g.value() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry window-wrap quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_window_quantiles_at_wrap_boundary():
+    """count/total/mean are cumulative across the whole stream, while
+    quantiles reflect only the surviving window — the distinction the
+    continuous exporter's interval records rely on."""
+    h = reg.Histogram("w", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    assert h.summary() == {"count": 4, "mean": 2.5, "p50": 3.0,
+                           "p95": 4.0, "p99": 4.0}
+    # two more observations evict 1.0 and 2.0
+    h.observe(5.0)
+    h.observe(6.0)
+    s = h.summary()
+    assert s["count"] == 6                 # cumulative, not window
+    assert s["mean"] == pytest.approx(21 / 6, abs=1e-3)  # cumulative
+    assert s["p50"] == 5.0                 # window [3,4,5,6] only
+    assert s["p99"] == 6.0
+    assert h.quantile(0.0) == 3.0          # the wrap discarded 1 and 2
+    assert h.total() == 21.0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def _sample_registry():
+    r = reg.MetricsRegistry()
+    c = r.counter("serve.requests", "request events")
+    c.inc(3, event="ok")
+    c.inc(1, event="err")
+    g = r.gauge("plan.inflight", "in-flight batches")
+    g.set(2)
+    h = r.histogram("serve.latency_ms", "per-request latency", window=8)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v, bucket="pdlp#0")
+    return r
+
+
+def test_prometheus_label_escaping_and_name_sanitizing():
+    r = reg.MetricsRegistry()
+    r.gauge("odd.name-x", "help with\nnewline and \\ slash").set(
+        1.5, path='a\\b"c\nd')
+    text = obs_export.render_prometheus(r)
+    assert "# HELP dispatches_tpu_odd_name_x help with\\nnewline and "\
+           "\\\\ slash\n" in text
+    assert 'dispatches_tpu_odd_name_x{path="a\\\\b\\"c\\nd"} 1.5' in text
+
+
+def test_prometheus_deterministic_ordering():
+    text = obs_export.render_prometheus(_sample_registry())
+    # metrics sorted by name, series sorted by label set
+    i_plan = text.index("dispatches_tpu_plan_inflight")
+    i_lat = text.index("dispatches_tpu_serve_latency_ms")
+    i_req = text.index("dispatches_tpu_serve_requests")
+    assert i_plan < i_lat < i_req
+    assert (text.index('event="err"') < text.index('event="ok"'))
+    # two renders of the same registry are byte-identical
+    assert text == obs_export.render_prometheus(_sample_registry())
+
+
+def test_prometheus_histogram_renders_as_summary():
+    text = obs_export.render_prometheus(_sample_registry())
+    assert "# TYPE dispatches_tpu_serve_latency_ms summary" in text
+    assert ('dispatches_tpu_serve_latency_ms{bucket="pdlp#0",'
+            'quantile="0.5"} 3.0') in text
+    assert 'dispatches_tpu_serve_latency_ms_sum{bucket="pdlp#0"} 10.0' \
+        in text
+    assert 'dispatches_tpu_serve_latency_ms_count{bucket="pdlp#0"} 4.0' \
+        in text
+
+
+def test_prometheus_golden_file_byte_stable():
+    """The full rendering is pinned byte-for-byte: any formatting
+    drift (ordering, float repr, escaping) breaks this test before it
+    breaks somebody's scrape pipeline."""
+    text = obs_export.render_prometheus(_sample_registry())
+    with open(PROM_GOLDEN, "rb") as f:
+        assert text.encode() == f.read()
+
+
+# ---------------------------------------------------------------------------
+# continuous exporter
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_requires_directory():
+    with pytest.raises(ValueError):
+        obs_export.ContinuousExporter(obs_export.ExportOptions())
+
+
+def test_exporter_interval_records_and_deltas(tmp_path):
+    clock = FakeClock()
+    r = reg.MetricsRegistry()
+    c = r.counter("ticks")
+    exp = obs_export.ContinuousExporter(
+        obs_export.ExportOptions(directory=str(tmp_path), interval_s=10.0),
+        clock=clock, registry=r)
+    c.inc(3)
+    path = exp.maybe_export()
+    assert path is not None           # first call = baseline record
+    assert exp.maybe_export() is None  # not due yet
+    clock.advance(9.0)
+    assert exp.maybe_export() is None
+    clock.advance(1.0)
+    c.inc(2)
+    assert exp.maybe_export() == path
+    recs = [json.loads(line) for line in open(path)]
+    assert [r_["seq"] for r_ in recs] == [1, 2]
+    assert recs[0]["delta"]["ticks"]["delta"][""] == 3
+    assert recs[1]["delta"]["ticks"]["delta"][""] == 2  # windowed delta
+    assert recs[1]["t"] == 10.0
+    # the Prometheus textfile is rewritten alongside every record
+    prom = open(os.path.join(str(tmp_path), obs_export.PROM_FILE)).read()
+    assert "dispatches_tpu_ticks 5.0" in prom
+
+
+def test_exporter_rotation_bounds_files(tmp_path):
+    clock = FakeClock()
+    r = reg.MetricsRegistry()
+    c = r.counter("n")
+    exp = obs_export.ContinuousExporter(
+        obs_export.ExportOptions(directory=str(tmp_path), interval_s=1.0,
+                                 max_records=2, max_files=2),
+        clock=clock, registry=r)
+    for _ in range(7):
+        c.inc()
+        exp.export()
+    names = sorted(n for n in os.listdir(str(tmp_path))
+                   if n.endswith(".jsonl"))
+    assert len(names) == 2            # bounded, oldest pruned
+    assert names[-1] == "telemetry-00004.jsonl"
+    total = sum(1 for n in names
+                for _ in open(os.path.join(str(tmp_path), n)))
+    assert total == 3                 # 2 in file 3, 1 in file 4
+
+
+def test_exporter_options_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_DIR", str(tmp_path))
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_INTERVAL_S", "2.5")
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_MAX_FILES", "3")
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_MAX_RECORDS", "17")
+    opts = obs_export.ExportOptions.from_env()
+    assert opts == obs_export.ExportOptions(
+        directory=str(tmp_path), interval_s=2.5, max_files=3,
+        max_records=17)
+    assert obs_export.enabled()
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_EXPORT_DIR")
+    assert not obs_export.enabled()
+
+
+def test_serve_run_with_export_produces_prom_and_two_records(
+        monkeypatch, tmp_path):
+    """Acceptance: a SolveService run with export enabled yields
+    parseable Prometheus text plus >= 2 JSONL interval records under
+    the injectable clock."""
+    from tests.test_serve import _arbitrage_nlp, _toy_base_solver
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_DIR", str(tmp_path))
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_EXPORT_INTERVAL_S", "5")
+    clock = FakeClock()
+    service = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9),
+                           clock=clock)
+    assert service._exporter is not None
+    nlp = _arbitrage_nlp(4)
+    for _ in range(2):   # max_batch=2: flush + baseline export record
+        service.submit(nlp, base_solver=_toy_base_solver)
+    clock.advance(5.0)
+    service.poll()       # second interval record
+    jsonl = [n for n in os.listdir(str(tmp_path)) if n.endswith(".jsonl")]
+    assert len(jsonl) == 1
+    recs = [json.loads(line)
+            for line in open(os.path.join(str(tmp_path), jsonl[0]))]
+    assert len(recs) >= 2
+    assert recs[0]["schema"] == obs_export.SCHEMA_VERSION
+    prom = open(os.path.join(str(tmp_path), obs_export.PROM_FILE)).read()
+    assert "# TYPE dispatches_tpu_serve_requests counter" in prom
+    for line in prom.splitlines():     # parseable: every line is a
+        if line.startswith("#"):       # comment or "name{labels} value"
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)
+        assert name_part.startswith("dispatches_tpu_")
+
+
+def test_serve_without_export_flag_is_not_armed(monkeypatch):
+    from tests.test_serve import _arbitrage_nlp, _toy_base_solver
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_EXPORT_DIR", raising=False)
+    # the disarmed hot path must never touch the exporter module
+    monkeypatch.setattr(
+        obs_export, "ContinuousExporter",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("armed")))
+    service = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9))
+    assert service._exporter is None
+    nlp = _arbitrage_nlp(4)
+    service.submit(nlp, base_solver=_toy_base_solver)
+    service.flush_all()
+    service.poll()
+
+
+# ---------------------------------------------------------------------------
+# flight bundle plan section
+# ---------------------------------------------------------------------------
+
+
+def test_flight_bundle_carries_plan_section(tmp_path):
+    trace.enable(True)
+    plan = _drive_plan(n_batches=2)
+    obs_flight.enable(str(tmp_path))
+    try:
+        path = obs_flight.trigger("deadline_miss", request_id=1,
+                                  bucket="pdlp#0")
+        assert path is not None
+        bundle = obs_flight.load_bundle(path)
+        sec = bundle["plan"]
+        assert sec["inflight"] == 0.0  # drained at trigger time
+        tail_names = {e["name"] for e in sec["timeline_tail"]}
+        assert tail_names <= set(obs_timeline.PLAN_SPAN_NAMES)
+        assert "plan.submit" in tail_names
+        assert any((e["args"] or {}).get("plan") == plan.plan_id
+                   for e in sec["timeline_tail"])
+    finally:
+        obs_flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# CLI + ledger loop
+# ---------------------------------------------------------------------------
+
+
+def test_cli_timeline_from_trace_file(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(json.dumps(
+        {"traceEvents": _pipelined_events()}))
+    rc = main(["--timeline", "--json", "--trace-file", str(trace_path)])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["timeline"]["overlap_efficiency"] == 0.5
+
+    rc = main(["--timeline", "--trace-file", str(trace_path)])
+    assert rc == 0
+    assert "overlap efficiency" in capsys.readouterr().out
+
+
+def test_cli_export_trace_merges_counter_tracks(tmp_path, capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(json.dumps(
+        {"traceEvents": _pipelined_events()}))
+    out_path = tmp_path / "merged.json"
+    rc = main(["--timeline", "--trace-file", str(trace_path),
+               "--export-trace", str(out_path)])
+    assert rc == 0
+    merged = json.load(open(out_path))["traceEvents"]
+    assert any(e["ph"] == "C" and e["name"].startswith("plan.inflight#")
+               for e in merged)
+
+
+def test_overlap_efficiency_is_a_gated_ledger_metric():
+    from dispatches_tpu.obs import ledger
+
+    assert ledger.GATED_METRICS["overlap_efficiency"] == +1
+    assert "plan_stall_pct" not in ledger.GATED_METRICS  # recorded only
