@@ -252,6 +252,16 @@ SERVE_KEYS = ("n_requests", "max_batch", "requests_done", "solves_per_sec",
               "compile_count", "programs", "serve_p99_ms",
               "deadline_miss_rate")
 SERVE_NONNULL_KEYS = ("serve_p99_ms", "deadline_miss_rate")
+#: sub-keys of the ``soak`` section (obs.soak): a short real-clock
+#: deadline-bearing Poisson replay of the arbitrage LP through the
+#: full streaming-telemetry stack.  ``soak_p99_ms`` is the streaming
+#: (P²) tail over the replay after lane-program warmup;
+#: ``slo_burn_max`` is the worst multi-window burn rate any objective
+#: reached.  Both feed the perf ledger (gated, lower is better).
+SOAK_KEYS = ("n_requests", "requests_done", "duration_s", "rate_rps",
+             "soak_p50_ms", "soak_p99_ms", "queue_wait_p95_ms",
+             "deadline_miss_rate", "slo_burn_max", "alerts_total")
+SOAK_NONNULL_KEYS = ("soak_p99_ms", "slo_burn_max")
 #: the execution-plan dispatch A/B (ISSUE 9): the same compiled PDLP
 #: kernel over identical batches, dispatched (a) legacy-style — per-lane
 #: device stacking, fence after every batch, single device — vs (b)
@@ -322,6 +332,16 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench serve SLO metrics must be measured, not null: "
                 f"{nulls}")
+    soak = out.get("soak")
+    if soak is not None:
+        missing = [k for k in SOAK_KEYS if k not in soak]
+        if missing:
+            raise ValueError(f"bench soak missing sub-keys: {missing}")
+        nulls = [k for k in SOAK_NONNULL_KEYS if soak.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench soak headline metrics must be measured, not "
+                f"null: {nulls}")
     plan = out.get("plan")
     if plan is not None:
         missing = [k for k in PLAN_KEYS if k not in plan]
@@ -383,6 +403,13 @@ def _finalize_output(out):
             metrics["overlap_efficiency"] = plan["overlap_efficiency"]
         if plan.get("plan_stall_pct") is not None:
             metrics["plan_stall_pct"] = plan["plan_stall_pct"]
+        # soak-section streaming tails: the long-churn guardrails
+        # (lower is better for both)
+        soak = out.get("soak") or {}
+        if soak.get("soak_p99_ms") is not None:
+            metrics["soak_p99_ms"] = soak["soak_p99_ms"]
+        if soak.get("slo_burn_max") is not None:
+            metrics["slo_burn_max"] = soak["slo_burn_max"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -938,6 +965,50 @@ def run_bench():
             }
     except Exception as exc:  # telemetry must never kill the headline
         out["plan_bench_error"] = str(exc)[:120]
+
+    # ---- real-clock soak: the streaming-telemetry stack (obs.soak)
+    # over a short deadline-bearing Poisson replay of the arbitrage LP.
+    # Lane programs are pre-warmed so soak_p99_ms measures steady-state
+    # dispatch + solve tails, not compile spikes; soak_p99_ms and
+    # slo_burn_max feed the ledger gate ---------------------------------
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.obs import soak as obs_soak
+            from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+
+            soak_rate = 40.0
+            soak_spec = obs_soak.load_soak_spec(overrides={
+                "traffic": {"process": "poisson", "rate_rps": soak_rate,
+                            "duration_s": 3.0, "seed": 7,
+                            "perturb": ["price"], "rho": 0.9,
+                            "sigma": 0.05, "deadline_ms": 400.0},
+                "service": {"max_batch": 4, "max_wait_ms": 10.0,
+                            "inflight": 2},
+                "slo": {"latency_p99_ms": 250.0,
+                        "queue_wait_p95_ms": 150.0,
+                        "deadline_miss_ratio": 0.02},
+            })
+            rep = obs_soak.run_soak(
+                soak_spec, nlp=_arbitrage_nlp(8), solver="pdlp",
+                virtual=False, warmup_lanes=(1, 2, 3, 4))
+            n_sub = rep["requests"]["submitted"]
+            out["soak"] = {
+                "n_requests": n_sub,
+                "requests_done": rep["requests"]["done"],
+                "duration_s": rep["duration_s"],
+                "rate_rps": soak_rate,
+                "soak_p50_ms": rep["latency_ms"]["streaming"].get("p50"),
+                "soak_p99_ms": rep["soak_p99_ms"],
+                "queue_wait_p95_ms":
+                    rep["queue_wait_ms"]["streaming"].get("p95"),
+                "deadline_miss_rate": (
+                    rep["requests"]["deadline_missed"] / n_sub
+                    if n_sub else None),
+                "slo_burn_max": rep["slo_burn_max"],
+                "alerts_total": rep["slo"]["alerts_total"],
+            }
+    except Exception as exc:
+        out["soak_bench_error"] = str(exc)[:120]
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------

@@ -56,11 +56,24 @@ REGISTERED_FLAGS = {
     "default 0.3)",
     "OBS_FLIGHT_DIR": "flight-recorder bundle directory; setting it "
     "arms the trigger hooks (deadline miss, quarantine/refine-fail, "
-    "nan-guard trip, solver non-convergence) in serve/sweep/runtime "
-    "(obs.flight; unset = recorder disarmed, zero writes)",
+    "nan-guard trip, solver non-convergence, burn-rate alerts) in "
+    "serve/sweep/runtime (obs.flight; unset = recorder disarmed, zero "
+    "writes)",
+    "OBS_FLIGHT_COOLDOWN_S": "flight-recorder per-trigger-kind "
+    "cooldown override in seconds, applied to every kind (obs.flight; "
+    "unset = per-kind defaults: 30 s for burn_rate, 0 for the "
+    "event-shaped kinds)",
     "OBS_SLO": "default SLO spec JSON path for `python -m "
     "dispatches_tpu.obs --slo` (obs.slo; unset = built-in example "
     "objectives)",
+    "SOAK_SPEC": "default soak spec JSON path for `python -m "
+    "dispatches_tpu.obs --soak` (obs.soak; unset = built-in "
+    "DEFAULT_SPEC; `--spec` wins over the flag)",
+    "SOAK_DURATION_S": "override the soak traffic duration in seconds "
+    "for `--soak` (obs.soak; `--duration` wins over the flag)",
+    "SOAK_REPORT_DIR": "directory `--soak` writes soak_report.json "
+    "and exporter records into (obs.soak; `--out` wins; unset with no "
+    "--out = report to stdout only)",
     "PDLP_ALGO": "override PDLPOptions.algorithm ('avg' | 'halpern') "
     "for every PDLP consumer (solvers.pdlp.resolve_pdlp_algorithm; "
     "read at solver-build time)",

@@ -143,6 +143,8 @@ CORPUS: Dict[str, Dict[str, str]] = {
             chunk = os.environ.get("DISPATCHES_TPU_SWEEP_TURBO_CHUNK")
             led = os.environ.get("DISPATCHES_TPU_OBS_LEDGER")
             exp = os.environ.get("DISPATCHES_TPU_OBS_EXPORT")
+            soak = os.environ.get("DISPATCHES_TPU_SOAK_SPEC_PATH")
+            cool = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN")
         """,
         "good": """
             import os
@@ -162,6 +164,10 @@ CORPUS: Dict[str, Dict[str, str]] = {
             rounds = os.environ.get("DISPATCHES_TPU_PDLP_REFINE_ROUNDS")
             inflight = os.environ.get("DISPATCHES_TPU_PLAN_INFLIGHT")
             ndev = os.environ.get("DISPATCHES_TPU_PLAN_DEVICES")
+            soak_spec = os.environ.get("DISPATCHES_TPU_SOAK_SPEC")
+            soak_dur = os.environ.get("DISPATCHES_TPU_SOAK_DURATION_S")
+            soak_out = os.environ.get("DISPATCHES_TPU_SOAK_REPORT_DIR")
+            cool = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN_S")
         """,
     },
     "GL008": {

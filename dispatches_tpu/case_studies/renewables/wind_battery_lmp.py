@@ -21,6 +21,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.case_studies.renewables import load_parameters as lp
+
+
+def _last(arr):
+    """``arr[-1]`` with the index pinned to int32.  ``arr[-1]``'s VJP is
+    a ``dynamic_update_slice`` whose start index is s64 under x64;
+    spmd-partitioning a vmapped while-loop body then fails HLO
+    verification ("Binary op compare with different element types:
+    s64[] and s32[]"), so every last-element read in a kernel that the
+    sharded sweep may partition must go through an explicit int32 take."""
+    return jnp.take(arr, jnp.asarray(arr.shape[0] - 1, jnp.int32))
 from dispatches_tpu.case_studies.renewables.flowsheet import REModel, create_model
 from dispatches_tpu.models.wind_power import sam_windpower_capacity_factors
 from dispatches_tpu.solvers import IPMOptions, make_ipm_solver, solve_nlp
@@ -68,10 +78,15 @@ def wind_battery_model(
     fs.fix("battery.initial_energy_throughput", 0.0)
 
     # periodic storage constraint (reference periodic pairs :40-50):
-    # final SoC returns to the initial SoC
+    # final SoC returns to the initial SoC.  The last-element read uses
+    # _last (an int32-indexed take, not ``[-1]``): under x64 the VJP of
+    # negative indexing lowers to a dynamic_update_slice with an s64
+    # start index, which the spmd partitioner rejects inside
+    # vmap(while) ("compare s64 vs s32" after partitioning) — the
+    # sharded production sweep hits exactly that.
     fs.add_eq(
         "periodic_soc",
-        lambda v, p: v["battery.state_of_charge"][-1]
+        lambda v, p: _last(v["battery.state_of_charge"])
         - v["battery.initial_state_of_charge"],
     )
 
@@ -141,7 +156,7 @@ def wind_battery_pricetaker_nlp(n_time_points: int, input_params: dict,
             lp.batt_rep_cost_kwh
             * p["battery.degradation_rate"]
             * (
-                v["battery.energy_throughput"][-1]
+                _last(v["battery.energy_throughput"])
                 - v["battery.initial_energy_throughput"]
             )
         )

@@ -32,6 +32,12 @@
     # registry as Prometheus text exposition (obs.export)
     python -m dispatches_tpu.obs --prom
 
+    # soak harness: replay a deterministic traffic spec against a
+    # SolveService in virtual time, grade SLO burn rates continuously,
+    # dump flight bundles on alerts, write soak_report.json
+    python -m dispatches_tpu.obs --soak [--json] [--spec FILE]
+        [--duration S] [--real] [--out DIR]
+
 The demo workload is a small batch-serve session (the same battery
 arbitrage LP the serve CLI uses) with obs force-enabled, so the report
 exercises the real instrumentation: serve batch spans, ``graft_jit``
@@ -129,7 +135,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print the metrics registry as Prometheus "
                              "text exposition (runs the demo workload "
                              "when the registry is empty)")
+    parser.add_argument("--soak", action="store_true",
+                        help="replay a traffic spec against a stub "
+                             "SolveService, grade SLO burn rates, and "
+                             "write a soak report (virtual-time by "
+                             "default)")
+    parser.add_argument("--spec", metavar="PATH", default=None,
+                        help="with --soak: soak spec JSON (default: the "
+                             "DISPATCHES_TPU_SOAK_SPEC flag, then the "
+                             "built-in spec)")
+    parser.add_argument("--duration", type=float, default=None,
+                        metavar="S",
+                        help="with --soak: override the traffic "
+                             "duration in (virtual) seconds")
+    parser.add_argument("--real", action="store_true",
+                        help="with --soak: run on the real clock "
+                             "instead of virtual time")
+    parser.add_argument("--out", metavar="DIR", default=None,
+                        help="with --soak: directory for "
+                             "soak_report.json and exporter records "
+                             "(default: the DISPATCHES_TPU_SOAK_"
+                             "REPORT_DIR flag, then stdout only)")
     args = parser.parse_args(argv)
+
+    if args.soak:
+        return _soak_main(args)
 
     if args.ledger or args.check_regressions:
         return _ledger_main(args)
@@ -286,6 +316,39 @@ def _flight_main(args) -> int:
             print(f"{b['path']}: {b['kind']}"
                   + (f" request_id={rid}" if rid is not None else "")
                   + (f" bucket={b['bucket']}" if b.get("bucket") else ""))
+    return 0
+
+
+def _soak_main(args) -> int:
+    import os
+
+    from dispatches_tpu.analysis.flags import flag_name
+    from dispatches_tpu.obs import soak
+
+    spec_path = args.spec or os.environ.get(flag_name("SOAK_SPEC")) \
+        or None
+    overrides = None
+    duration = args.duration
+    if duration is None:
+        env_dur = os.environ.get(flag_name("SOAK_DURATION_S"), "")
+        if env_dur:
+            try:
+                duration = float(env_dur)
+            except ValueError:
+                duration = None
+    if duration is not None:
+        overrides = {"traffic": {"duration_s": float(duration)}}
+    out_dir = args.out or os.environ.get(
+        flag_name("SOAK_REPORT_DIR")) or None
+    spec = soak.load_soak_spec(spec_path, overrides=overrides)
+    report_ = soak.run_soak(spec, virtual=not args.real,
+                            out_dir=out_dir,
+                            flight_dir=args.flight_dir or None)
+    if args.json:
+        print(json.dumps(report_, indent=2, sort_keys=True,
+                         default=str))
+    else:
+        print(soak.format_soak_report(report_), end="")
     return 0
 
 

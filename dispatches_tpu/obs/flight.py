@@ -58,7 +58,11 @@ __all__ = [
     "bundles",
     "load_bundle",
     "reset",
+    "set_clock",
+    "set_cooldown",
+    "suppressed_counts",
     "TRIGGER_KINDS",
+    "DEFAULT_COOLDOWN_S",
     "MAX_BUNDLES",
     "TAIL_EVENTS",
 ]
@@ -76,12 +80,26 @@ TRIGGER_KINDS = (
     "refine_failed",
     "nan_guard",
     "solver_nonconverged",
+    "burn_rate",
 )
+
+#: per-kind trigger cooldown defaults (seconds).  A sustained burn-rate
+#: alert re-fires every monitor check — without a cooldown it would
+#: churn through all MAX_BUNDLES in seconds and evict the bundle that
+#: actually shows the onset.  Event-shaped kinds (one trigger per
+#: failed request/point) default to 0 so a burst of distinct failures
+#: still dumps one bundle each; ``DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN_S``
+#: (or :func:`set_cooldown`) overrides the cooldown for ALL kinds.
+DEFAULT_COOLDOWN_S: Dict[str, float] = {"burn_rate": 30.0}
 
 _lock = threading.Lock()
 _seq = itertools.count(1)
 _DIR_OVERRIDE: Optional[str] = None
 _last_snapshot: Optional[Dict] = None
+_clock = time.monotonic            # injectable: soaks run virtual time
+_COOLDOWN_OVERRIDE: Optional[float] = None
+_last_fire: Dict[str, float] = {}  # kind -> last written-bundle time
+_suppressed: Dict[str, int] = {}   # kind -> suppressed since last write
 
 
 def _dir() -> str:
@@ -105,12 +123,51 @@ def enable(directory: Optional[str]) -> None:
     _DIR_OVERRIDE = directory if directory is None else str(directory)
 
 
+def set_clock(fn) -> None:
+    """Install the clock the trigger cooldown runs on (None restores
+    ``time.monotonic``) — the soak harness points it at its virtual
+    clock so coalescing windows are measured in replayed time."""
+    global _clock
+    _clock = time.monotonic if fn is None else fn
+
+
+def set_cooldown(seconds: Optional[float]) -> None:
+    """Process-level cooldown override for ALL trigger kinds (wins over
+    the env flag; None restores per-kind defaults)."""
+    global _COOLDOWN_OVERRIDE
+    _COOLDOWN_OVERRIDE = None if seconds is None else float(seconds)
+
+
+def _cooldown_for(kind: str) -> float:
+    if _COOLDOWN_OVERRIDE is not None:
+        return _COOLDOWN_OVERRIDE
+    raw = os.environ.get(flag_name("OBS_FLIGHT_COOLDOWN_S"), "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_COOLDOWN_S.get(kind, 0.0)
+
+
+def suppressed_counts() -> Dict[str, int]:
+    """Triggers suppressed by the cooldown since the last written
+    bundle (per kind) — the next bundle carries and resets these."""
+    with _lock:
+        return dict(_suppressed)
+
+
 def reset() -> None:
-    """Forget the override and the last-snapshot diff baseline."""
-    global _DIR_OVERRIDE, _last_snapshot
+    """Forget the override, the diff baseline, and the cooldown state
+    (clock + last-fire times + suppressed counts)."""
+    global _DIR_OVERRIDE, _last_snapshot, _clock, _COOLDOWN_OVERRIDE
     with _lock:
         _DIR_OVERRIDE = None
         _last_snapshot = None
+        _clock = time.monotonic
+        _COOLDOWN_OVERRIDE = None
+        _last_fire.clear()
+        _suppressed.clear()
 
 
 def trigger(kind: str, *, request_id: Optional[int] = None,
@@ -130,6 +187,17 @@ def trigger(kind: str, *, request_id: Optional[int] = None,
     directory = _dir()
     if not directory:
         return None
+    # cooldown check AFTER the disarmed early-return: the recorder
+    # stays zero-overhead when off (spy-pinned)
+    cooldown = _cooldown_for(kind)
+    if cooldown > 0:
+        now = _clock()
+        with _lock:
+            last = _last_fire.get(kind)
+            if last is not None and now - last < cooldown:
+                _suppressed[kind] = _suppressed.get(kind, 0) + 1
+                return None
+            _last_fire[kind] = now
     try:
         return _write_bundle(
             directory, kind, request_id=request_id, bucket=bucket,
@@ -154,6 +222,8 @@ def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
         diff = _registry.diff_snapshots(baseline, snapshot)
         _last_snapshot = snapshot
         seq = next(_seq)
+        suppressed = dict(_suppressed)  # coalesced since the last write
+        _suppressed.clear()
     tail = _trace.to_chrome_events(_trace.events()[-TAIL_EVENTS:])
     plan_section = _plan_section(snapshot, _trace.events())
     cost_card = None
@@ -179,6 +249,7 @@ def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,
             "solver_options": solver_options,
             "detail": detail,
         },
+        "suppressed_since_last": suppressed,
         "trace_tail": tail,
         "trace_dropped": _trace.dropped(),
         "plan": plan_section,

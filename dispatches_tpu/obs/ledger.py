@@ -96,6 +96,12 @@ GATED_METRICS = {
     # its fence-bound component grows with device utilisation, so a
     # one-sided gate would misfire.
     "overlap_efficiency": +1,
+    # bench soak section (obs.soak): streaming P² p99 over the
+    # real-clock deadline-bearing replay after lane-program warmup,
+    # and the worst multi-window SLO burn rate any objective reached —
+    # the long-churn guardrails for the serve/plan stack
+    "soak_p99_ms": -1,
+    "slo_burn_max": -1,
 }
 
 _GIT_SHA: Optional[str] = None

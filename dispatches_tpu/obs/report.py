@@ -22,9 +22,12 @@ __all__ = [
 
 def aggregate_spans(events: List[Dict]) -> Dict[str, Dict]:
     """Per-name rollup of span (``ph: X``) and instant (``ph: i``)
-    events: ``{name: {count, total_ms, mean_ms, max_ms}}`` for spans,
-    ``{name: {count}}`` for instants."""
+    events: ``{name: {count, total_ms, mean_ms, p50_ms, p95_ms, p99_ms,
+    max_ms}}`` for spans, ``{name: {count}}`` for instants."""
+    from dispatches_tpu.obs.online import interp_quantile
+
     out: Dict[str, Dict] = {}
+    durs: Dict[str, List[float]] = {}
     for e in events:
         name = e.get("name", "?")
         if e.get("ph") == "X":
@@ -35,14 +38,19 @@ def aggregate_spans(events: List[Dict]) -> Dict[str, Dict]:
             agg["count"] += 1
             agg["total_ms"] += dur_ms
             agg["max_ms"] = max(agg["max_ms"], dur_ms)
+            durs.setdefault(name, []).append(dur_ms)
         elif e.get("ph") == "i":
             agg = out.setdefault(name, {"count": 0})
             agg["count"] += 1
-    for agg in out.values():
+    for name, agg in out.items():
         if "total_ms" in agg:
             agg["mean_ms"] = round(agg["total_ms"] / max(agg["count"], 1), 3)
             agg["total_ms"] = round(agg["total_ms"], 3)
             agg["max_ms"] = round(agg["max_ms"], 3)
+            xs = sorted(durs[name])
+            for key, p in (("p50_ms", 0.5), ("p95_ms", 0.95),
+                           ("p99_ms", 0.99)):
+                agg[key] = round(interp_quantile(xs, p), 3)
     return out
 
 
@@ -73,6 +81,9 @@ def format_report(events: List[Dict],
                 f"  {name:<{width}}  {a['count']:6d} x  "
                 f"total {a['total_ms']:10.3f} ms  "
                 f"mean {a['mean_ms']:8.3f} ms  "
+                f"p50 {a['p50_ms']:8.3f} ms  "
+                f"p95 {a['p95_ms']:8.3f} ms  "
+                f"p99 {a['p99_ms']:8.3f} ms  "
                 f"max {a['max_ms']:8.3f} ms"
             )
     if instants:

@@ -1,0 +1,581 @@
+"""Soak harness: replay a traffic spec against a ``SolveService`` with
+streaming SLO grading, burn-rate alerting, and online stall attribution.
+
+The bench rounds answer "how fast is one batch"; the ROADMAP's
+millions-of-users tier asks a different question — what do p99, the
+error budget, and the pipeline stall split look like after *hours of
+churn*?  This module answers it without needing hours: the whole replay
+runs on the service's injectable clock, so virtual time is advanced
+request-to-request and a fast-lane test replays thousands of requests
+in well under a second of wall time, while the slow lane runs the same
+spec on ``time.monotonic`` against the real solver.
+
+One ``run_soak(spec)`` call wires the whole streaming stack together:
+
+* ``serve.traffic`` generates the deterministic open-loop request
+  stream (arrival process + correlated parameter perturbations);
+* per-request latency / queue-wait observations tee into
+  ``obs.online`` P² estimators, burn-rate monitors built over
+  ``obs.slo`` objectives, and KS drift detectors (latency and
+  ``pdhg_iters``);
+* plan lifecycle spans stream into the incremental
+  :class:`~dispatches_tpu.obs.online.TimelineAccumulator` via
+  ``trace.add_sink`` — live overlap/stall gauges with no post-hoc scan;
+* burn-rate alerts fire the flight recorder (``burn_rate`` kind, so
+  the per-kind cooldown coalesces a sustained violation into one
+  bundle) and the ``ContinuousExporter`` ticks on the same clock;
+* the result is a schema-stable soak report (``SOAK_SCHEMA``) whose
+  headline ``soak_p99_ms`` / ``slo_burn_max`` feed the perf ledger.
+
+In virtual mode the service *execution* time is modeled
+(:class:`ServiceTimeModel`: base + per-lane cost + seeded jitter, with
+spike windows for alert-path tests) by a plan subclass that advances
+the fake clock inside the fence — the device still runs the (tiny)
+stub kernel, but the latency distribution the SLOs grade is the
+model's, deterministic and hours-compressible.
+
+CLI: ``python -m dispatches_tpu.obs --soak [--json] [--spec FILE]
+[--duration S] [--real] [--out DIR]``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import online
+from dispatches_tpu.obs import slo as obs_slo
+from dispatches_tpu.obs import trace as obs_trace
+
+__all__ = [
+    "SOAK_SCHEMA",
+    "DEFAULT_SPEC",
+    "FakeClock",
+    "ServiceTimeModel",
+    "StubNLP",
+    "make_stub_solver",
+    "load_soak_spec",
+    "run_soak",
+    "format_soak_report",
+]
+
+SOAK_SCHEMA = 1
+
+
+class FakeClock:
+    """Monotone virtual clock (seconds); the soak driver advances it,
+    the service/plan/exporter/flight-cooldown all read it."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        if dt > 0:
+            self.t += float(dt)
+
+    def advance_to(self, t: float) -> None:
+        if t > self.t:
+            self.t = float(t)
+
+
+@dataclass(frozen=True)
+class ServiceTimeModel:
+    """Virtual per-batch execution time: ``base_ms + per_lane_ms *
+    lanes`` plus exponential jitter, multiplied by ``factor`` inside
+    any ``(t0_s, t1_s, factor)`` spike window (measured on the virtual
+    clock) — spikes are how tests inject an SLO violation."""
+
+    base_ms: float = 2.0
+    per_lane_ms: float = 0.25
+    jitter_ms: float = 0.5
+    seed: int = 0
+    spikes: Tuple[Tuple[float, float, float], ...] = ()
+
+    def sampler(self, clock: Callable[[], float]):
+        import numpy as np
+
+        rng = np.random.default_rng(self.seed + 0x50AC)
+
+        def service_time_s(ticket) -> float:
+            ms = self.base_ms + self.per_lane_ms * float(ticket.lanes)
+            if self.jitter_ms > 0:
+                ms += float(rng.exponential(self.jitter_ms))
+            now = clock()
+            for t0, t1, factor in self.spikes:
+                if t0 <= now < t1:
+                    ms *= factor
+            return ms / 1e3
+
+        return service_time_s
+
+
+def _soak_plan(options, clock: FakeClock, service_time_s):
+    """An ``ExecutionPlan`` whose fence advances the virtual clock by
+    the modeled execution time of the batch being completed — so
+    fence-time latency accounting sees queue wait + modeled service
+    time instead of the stub kernel's microseconds."""
+    from dispatches_tpu.plan.execution import ExecutionPlan
+
+    class _SoakPlan(ExecutionPlan):
+        def _complete_oldest(self):
+            if self._window:
+                clock.advance(service_time_s(self._window[0]))
+            return super()._complete_oldest()
+
+    return _SoakPlan(options)
+
+
+# ---------------------------------------------------------------------------
+# minimal-compile stub workload
+# ---------------------------------------------------------------------------
+
+
+class StubNLP:
+    """The smallest object the service's pdlp-with-``base_solver`` path
+    accepts: just ``default_params()``.  Virtual soaks use it so tier-1
+    replays compile only the trivial stub kernel (one tiny XLA program
+    per lane count), never a real solver."""
+
+    def __init__(self, n: int = 8):
+        import numpy as np
+
+        self.n = int(n)
+        self._price = np.linspace(1.0, 2.0, self.n)
+
+    def default_params(self) -> Dict:
+        import numpy as np
+
+        return {"p": {"price": np.array(self._price)}, "fixed": {}}
+
+
+def make_stub_solver():
+    """A jnp-traceable per-scenario ``solve(params)`` for the stub:
+    objective and a deterministic params-dependent ``iters`` (so the
+    pdhg-iters drift detector has a real signal), always converged."""
+    import jax.numpy as jnp
+    from typing import NamedTuple
+
+    class StubResult(NamedTuple):
+        obj: object
+        converged: object
+        iters: object
+
+    def solve(params):
+        price = params["p"]["price"]
+        obj = jnp.sum(price)
+        # iters tracks the stream's parameter level: a drifting price
+        # signal shows up as a drifting iteration distribution
+        iters = jnp.asarray(20.0 + 40.0 * jnp.mean(price), jnp.float32)
+        return StubResult(obj=obj, converged=jnp.asarray(True),
+                          iters=iters)
+
+    return solve
+
+
+# ---------------------------------------------------------------------------
+# spec handling
+# ---------------------------------------------------------------------------
+
+#: the default virtual soak: ~5 virtual seconds of Poisson traffic at
+#: 250 rps (≈1.2k requests) with a correlated price stream, graded
+#: against budgets sized for the service-time model.  Sections merge
+#: shallowly: a spec file overrides per key, not per section.
+DEFAULT_SPEC: Dict = {
+    "traffic": {
+        "process": "poisson",
+        "rate_rps": 250.0,
+        "duration_s": 5.0,
+        "seed": 0,
+        "perturb": ["price"],
+        "rho": 0.9,
+        "sigma": 0.05,
+    },
+    "service": {"max_batch": 8, "max_wait_ms": 20.0, "inflight": 2},
+    "service_time": {"base_ms": 2.0, "per_lane_ms": 0.25,
+                     "jitter_ms": 0.5, "seed": 0, "spikes": []},
+    "slo": {"latency_p99_ms": 200.0, "queue_wait_p95_ms": 100.0,
+            "deadline_miss_ratio": 0.01},
+    # [fast_s, slow_s, threshold] pairs sized for minutes-long soaks
+    # (the canonical SRE 5m/1h pairs assume a 30-day budget horizon)
+    "burn_rules": [[2.0, 10.0, 1.5], [5.0, 30.0, 1.2]],
+    "check_interval_s": 0.5,
+    "export_interval_s": 5.0,
+}
+
+
+def load_soak_spec(path: Optional[str] = None,
+                   overrides: Optional[Dict] = None) -> Dict:
+    """DEFAULT_SPEC with a spec file and explicit overrides merged over
+    it (per-section shallow merge; unknown sections rejected)."""
+    spec = {k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in DEFAULT_SPEC.items()}
+    for layer in (json.loads(open(path).read()) if path else None,
+                  overrides):
+        if not layer:
+            continue
+        unknown = sorted(set(layer) - set(DEFAULT_SPEC))
+        if unknown:
+            raise ValueError(f"unknown soak spec sections: {unknown}")
+        for k, v in layer.items():
+            if isinstance(spec.get(k), dict) and isinstance(v, dict):
+                spec[k].update(v)
+            else:
+                spec[k] = v
+    return spec
+
+
+def _slo_spec(slo: Dict) -> "obs_slo.SLOSpec":
+    """The soak's objectives as a real ``obs.slo`` spec (ungrouped:
+    the soak grades the service aggregate, not per-bucket series)."""
+    return obs_slo.spec_from_dict({
+        "name": "soak",
+        "objectives": [
+            {"name": "soak_latency_p99", "kind": "quantile",
+             "metric": "serve.latency_ms", "p": "p99",
+             "target": slo["latency_p99_ms"]},
+            {"name": "soak_queue_wait_p95", "kind": "quantile",
+             "metric": "serve.queue_wait_ms", "p": "p95",
+             "target": slo["queue_wait_p95_ms"]},
+            {"name": "soak_deadline_miss_ratio", "kind": "ratio",
+             "num": {"metric": "serve.deadline",
+                     "labels": {"event": "missed"}},
+             "den": {"metric": "serve.requests",
+                     "labels": {"event": "submitted"}},
+             "target": slo["deadline_miss_ratio"]},
+        ],
+    })
+
+
+# ---------------------------------------------------------------------------
+# the replay driver
+# ---------------------------------------------------------------------------
+
+
+def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
+             solver: str = "pdlp", virtual: bool = True,
+             clock: Optional[Callable[[], float]] = None,
+             out_dir: Optional[str] = None,
+             flight_dir: Optional[str] = None,
+             warmup_lanes: Tuple[int, ...] = ()) -> Dict:
+    """Replay one traffic spec against a ``SolveService``; returns the
+    soak report (and writes ``soak_report.json`` plus exporter records
+    under ``out_dir`` when given).
+
+    Virtual mode (default) runs the stub workload on a
+    :class:`FakeClock` with modeled service times; ``virtual=False``
+    replays on ``time.monotonic`` against the real solver for ``nlp``
+    (or the stub when none is given — then wall time is real but
+    execution is still the stub kernel).
+
+    ``warmup_lanes`` pre-compiles the per-lane-count programs before
+    the streaming instruments attach (default-params requests, results
+    discarded) so real-clock soaks measure steady-state tails, not
+    compile spikes; the warmup requests do still show up in the
+    service-level ``metrics()`` section of the report.
+    """
+    from dispatches_tpu.serve import traffic as traffic_mod
+    from dispatches_tpu.serve.service import (RequestStatus, ServeOptions,
+                                              SolveService)
+
+    spec = load_soak_spec(overrides=spec)
+    tspec = traffic_mod.spec_from_dict(spec["traffic"])
+    svc_cfg = spec["service"]
+
+    if virtual:
+        clk = clock if clock is not None else FakeClock()
+    else:
+        clk = clock if clock is not None else time.monotonic
+
+    # -- service + plan ----------------------------------------------------
+    from dispatches_tpu.plan.execution import PlanOptions
+
+    plan_opts = PlanOptions(inflight=int(svc_cfg.get("inflight", 2)))
+    if virtual:
+        model = ServiceTimeModel(
+            base_ms=spec["service_time"]["base_ms"],
+            per_lane_ms=spec["service_time"]["per_lane_ms"],
+            jitter_ms=spec["service_time"]["jitter_ms"],
+            seed=int(spec["service_time"].get("seed", 0)),
+            spikes=tuple(tuple(s) for s in spec["service_time"]["spikes"]))
+        plan = _soak_plan(plan_opts, clk, model.sampler(clk))
+    else:
+        from dispatches_tpu.plan.execution import ExecutionPlan
+
+        plan = ExecutionPlan(plan_opts)
+    service = SolveService(
+        ServeOptions(max_batch=int(svc_cfg["max_batch"]),
+                     max_wait_ms=float(svc_cfg["max_wait_ms"]),
+                     warm_start=False, plan=plan),
+        clock=clk)
+
+    if nlp is None:
+        nlp = StubNLP()
+        if base_solver is None:
+            base_solver = make_stub_solver()
+            solver = "pdlp"
+
+    # pre-compile the lane-count programs before any instrument is
+    # attached: warmup latency is compile latency, not tail signal
+    if warmup_lanes:
+        warm_defaults = nlp.default_params()
+        for k in warmup_lanes:
+            warm = [service.submit(nlp, warm_defaults, solver=solver,
+                                   base_solver=base_solver)
+                    for _ in range(int(k))]
+            service.flush_all()
+            for h in warm:
+                h.result()
+
+    # -- streaming instruments ---------------------------------------------
+    lat_stream = online.StreamingQuantiles()
+    qw_stream = online.StreamingQuantiles()
+    lat_drift = online.DriftDetector()
+    iters_drift = online.DriftDetector()
+    rules = tuple(online.BurnRateRule(*r) for r in spec["burn_rules"])
+    slo_spec = _slo_spec(spec["slo"])
+    monitors = online.monitors_from_spec(
+        slo_spec, rules=rules,
+        check_interval_s=float(spec["check_interval_s"]))
+    lat_mons = [m for m in monitors if m.metric == "serve.latency_ms"]
+    qw_mons = [m for m in monitors if m.metric == "serve.queue_wait_ms"]
+    ratio_mons = [m for m in monitors if m.kind == "ratio"]
+
+    acc = online.TimelineAccumulator(plan=service.plan.plan_id)
+    latencies: List[float] = []
+    alerts: List[Dict] = []
+    bundle_paths: List[str] = []
+
+    trace_was_on = obs_trace.enabled()
+    if not trace_was_on:
+        obs_trace.enable(True)  # plan lifecycle spans feed the sink
+    obs_trace.add_sink(acc.ingest)
+
+    if flight_dir:
+        obs_flight.enable(str(flight_dir))
+    obs_flight.set_clock(clk)
+
+    exporter = None
+    if out_dir:
+        from dispatches_tpu.obs.export import (ContinuousExporter,
+                                               ExportOptions)
+
+        exporter = ContinuousExporter(
+            ExportOptions(directory=str(out_dir),
+                          interval_s=float(spec["export_interval_s"])),
+            clock=clk)
+        service.attach_exporter(exporter)
+
+    # latency/queue-wait tee: the service's window ``record`` calls
+    # happen exactly at fence/dispatch time, so shadowing them on the
+    # instance is the zero-copy streaming feed (restored in finally)
+    orig_lat = service._latency.record
+    orig_qw = service._queue_wait.record
+
+    def _lat_record(label: str, ms: float) -> None:
+        now = clk()
+        latencies.append(float(ms))
+        lat_stream.observe(ms)
+        lat_drift.observe(ms)
+        for m in lat_mons:
+            m.observe(now, ms)
+        orig_lat(label, ms)
+
+    def _qw_record(label: str, ms: float) -> None:
+        now = clk()
+        qw_stream.observe(ms)
+        for m in qw_mons:
+            m.observe(now, ms)
+        orig_qw(label, ms)
+
+    service._latency.record = _lat_record
+    service._queue_wait.record = _qw_record
+
+    # -- replay ------------------------------------------------------------
+    requests = traffic_mod.generate(tspec, nlp.default_params())
+    poll_dt = max(float(svc_cfg["max_wait_ms"]) / 1e3, 1e-3)
+    pending: deque = deque()
+    counts = {"scheduled": len(requests), "submitted": 0, "done": 0,
+              "timeout": 0, "deadline_missed": 0}
+
+    def _check_alerts() -> None:
+        now = clk()
+        for m in monitors:
+            for a in m.update(now):
+                alerts.append(a)
+                if obs_flight.enabled():
+                    p = obs_flight.trigger(
+                        "burn_rate", label=a["objective"], detail=a)
+                    if p is not None:
+                        bundle_paths.append(p)
+
+    def _harvest() -> None:
+        while pending and pending[0].done():
+            h = pending.popleft()
+            sr = h._result
+            now = clk()
+            missed = False
+            if sr.status == RequestStatus.DONE:
+                counts["done"] += 1
+                if h.deadline_at is not None:
+                    missed = (h.submitted_at + sr.latency_ms / 1e3
+                              > h.deadline_at)
+                iters = getattr(sr.result, "iters", None)
+                if iters is not None:
+                    iters_drift.observe(float(iters))
+            else:
+                counts["timeout"] += 1
+                missed = True
+            if missed:
+                counts["deadline_missed"] += 1
+            if h.deadline_at is not None or missed:
+                for m in ratio_mons:
+                    m.observe(now, 1.0 if missed else 0.0)
+        _check_alerts()
+
+    t0 = clk()
+    try:
+        for req in requests:
+            target = t0 + req.t
+            if virtual:
+                while clk() + poll_dt <= target:
+                    clk.advance(poll_dt)
+                    service.poll()
+                    _harvest()
+                clk.advance_to(target)
+            else:
+                while clk() < target:
+                    time.sleep(min(poll_dt, max(target - clk(), 0.0)))
+                    service.poll()
+                    _harvest()
+            pending.append(service.submit(
+                nlp, req.params, solver=solver, base_solver=base_solver,
+                deadline_ms=req.deadline_ms))
+            counts["submitted"] += 1
+            _harvest()
+        # drain the tail: one more wait quantum, then a pipelined flush
+        if virtual:
+            clk.advance(poll_dt)
+        service.poll()
+        service.flush_all()
+        _harvest()
+        assert not pending, "requests left incomplete after flush_all"
+        now = clk()
+        if exporter is not None:
+            exporter.export(now)
+    finally:
+        service._latency.record = orig_lat
+        service._queue_wait.record = orig_qw
+        obs_trace.remove_sink(acc.ingest)
+        obs_flight.set_clock(None)
+        if not trace_was_on:
+            obs_trace.enable(False)
+
+    # -- report ------------------------------------------------------------
+    posthoc = None
+    if latencies:
+        xs = sorted(latencies)
+        posthoc = {
+            "count": len(xs),
+            "mean": sum(xs) / len(xs),
+            "p50": online.interp_quantile(xs, 0.5),
+            "p95": online.interp_quantile(xs, 0.95),
+            "p99": online.interp_quantile(xs, 0.99),
+        }
+    burn_max = max((m.burn_peak for m in monitors), default=0.0)
+    lat_summary = lat_stream.summary()
+    report = {
+        "schema": SOAK_SCHEMA,
+        "virtual": bool(virtual),
+        "spec": {**spec, "traffic": tspec.to_dict()},
+        "duration_s": round(now - t0, 6),
+        "requests": counts,
+        "latency_ms": {"streaming": lat_summary, "posthoc": posthoc},
+        "queue_wait_ms": {"streaming": qw_stream.summary()},
+        "slo": {
+            "objectives": [m.state(now) for m in monitors],
+            "alerts": alerts,
+            "alerts_total": len(alerts),
+            "flight_bundles": len(bundle_paths),
+            "bundle_paths": bundle_paths,
+        },
+        "drift": {"latency": lat_drift.result(),
+                  "pdhg_iters": iters_drift.result()},
+        "timeline": acc.result(),
+        "service": service.metrics(),
+        "soak_p99_ms": lat_summary.get("p99"),
+        "slo_burn_max": round(burn_max, 4),
+    }
+    if out_dir:
+        import os
+
+        os.makedirs(str(out_dir), exist_ok=True)
+        path = os.path.join(str(out_dir), "soak_report.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, default=str)
+        os.replace(tmp, path)
+        report["report_path"] = path
+    return report
+
+
+def format_soak_report(report: Dict) -> str:
+    """Human-readable rendering for ``--soak``."""
+    lines = [f"== soak report ({'virtual' if report['virtual'] else 'real'} "
+             f"clock, {report['duration_s']:.2f} s) =="]
+    c = report["requests"]
+    lines.append(
+        f"requests: {c['submitted']} submitted, {c['done']} done, "
+        f"{c['timeout']} timeout, {c['deadline_missed']} deadline-missed")
+    s = report["latency_ms"]["streaming"]
+    ph = report["latency_ms"]["posthoc"]
+
+    def _ms(v):
+        return "-" if v is None else f"{v:.2f}"
+
+    lines.append(
+        f"latency ms (streaming P2): p50 {_ms(s.get('p50'))}  "
+        f"p95 {_ms(s.get('p95'))}  p99 {_ms(s.get('p99'))}"
+        + ("" if ph is None else
+           f"   (posthoc p99 {_ms(ph['p99'])})"))
+    qs = report["queue_wait_ms"]["streaming"]
+    lines.append(
+        f"queue wait ms: p50 {_ms(qs.get('p50'))}  "
+        f"p95 {_ms(qs.get('p95'))}  p99 {_ms(qs.get('p99'))}")
+    slo = report["slo"]
+    lines.append(
+        f"slo: burn_max {report['slo_burn_max']:.3f}, "
+        f"{slo['alerts_total']} alert(s), "
+        f"{slo['flight_bundles']} flight bundle(s)")
+    for o in slo["objectives"]:
+        firing = any(r["firing"] for r in o["rules"])
+        lines.append(
+            f"  {o['objective']:<28s} target {o['target']:<10g} "
+            f"burn_peak {o['burn_peak']:.3f}"
+            + ("  FIRING" if firing else ""))
+    for name, d in report["drift"].items():
+        ks = d["ks"]
+        lines.append(
+            f"drift[{name}]: ks "
+            + ("-" if ks is None else f"{ks:.3f}")
+            + (" DRIFTED" if d["drifted"] else ""))
+    tl = report["timeline"]
+    if tl is not None:
+        st = tl["stall"]
+        lines.append(
+            f"online timeline: {tl['n_batches']} batches, overlap "
+            f"{tl['overlap_efficiency']:.3f}, stall {st['stall_pct']:.1f}% "
+            f"[fence {st['fence_bound_us'] / 1e3:.2f} ms, host-stage "
+            f"{st['host_stage_bound_us'] / 1e3:.2f} ms, queue-empty "
+            f"{st['queue_empty_us'] / 1e3:.2f} ms]")
+    if "report_path" in report:
+        lines.append(f"report: {report['report_path']}")
+    return "\n".join(lines) + "\n"

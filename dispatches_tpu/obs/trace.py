@@ -43,6 +43,8 @@ __all__ = [
     "events",
     "dropped",
     "reset",
+    "add_sink",
+    "remove_sink",
     "set_memory_sampler",
     "export_chrome_trace",
     "to_chrome_events",
@@ -64,6 +66,29 @@ _STACK: contextvars.ContextVar = contextvars.ContextVar(
 # span-boundary hook (obs.profile installs its memory sampler here);
 # module-global so the Span hot path pays one attribute read when unset
 _SPAN_HOOK = None
+
+# streaming event sinks (obs.online's incremental timeline accumulator
+# subscribes here): each completed event is handed to every sink as it
+# is recorded, so consumers see spans the moment they retire instead of
+# re-scanning the ring.  Empty-list check on the hot path; sink
+# exceptions are swallowed (telemetry never breaks the traced op).
+_SINKS: List = []
+
+
+def add_sink(fn) -> None:
+    """Register ``fn(event_dict)`` to observe every recorded event."""
+    with _lock:
+        if fn not in _SINKS:
+            _SINKS.append(fn)
+
+
+def remove_sink(fn) -> None:
+    """Unregister a sink installed with :func:`add_sink` (idempotent)."""
+    with _lock:
+        try:
+            _SINKS.remove(fn)
+        except ValueError:
+            pass
 
 
 def set_memory_sampler(fn) -> None:
@@ -111,6 +136,13 @@ def _record(event: Dict) -> None:
         if len(buf) == buf.maxlen:
             _DROPPED += 1
         buf.append(event)
+        sinks = list(_SINKS) if _SINKS else None
+    if sinks is not None:  # outside the lock: sinks may touch telemetry
+        for fn in sinks:
+            try:
+                fn(event)
+            except Exception:
+                pass
 
 
 def _now_us() -> float:

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r09_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r10_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -45,6 +45,27 @@ def test_preview_record_passes_schema(bench):
     # the execution-plan dispatch A/B is pinned from r08 on
     for key in bench.PLAN_KEYS:
         assert key in out["plan"]
+    # the soak section (r10): streaming-telemetry tails over a
+    # real-clock replay, headline metrics measured
+    for key in bench.SOAK_KEYS:
+        assert key in out["soak"]
+    for key in bench.SOAK_NONNULL_KEYS:
+        assert out["soak"][key] is not None
+
+
+def test_preview_soak_section(bench):
+    """The r10 soak section backs the streaming-telemetry acceptance:
+    a real-clock deadline-bearing replay completed every request after
+    lane warmup, with sane tails (p50 <= p99) and a burn rate that
+    stayed inside budget on the recorded run (no alerts)."""
+    out = json.load(open(PREVIEW))
+    soak = out["soak"]
+    assert soak["n_requests"] > 0
+    assert soak["requests_done"] == soak["n_requests"]
+    assert 0.0 < soak["soak_p50_ms"] <= soak["soak_p99_ms"]
+    assert soak["slo_burn_max"] >= 0.0
+    assert soak["alerts_total"] == 0
+    assert soak["deadline_miss_rate"] == 0.0
 
 
 def test_preview_pdlp_variant_ab(bench):
@@ -196,6 +217,18 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["serve"]
+    bench.validate_bench_output(out)
+    # soak is optional-but-complete too, headline metrics non-null
+    out = json.load(open(PREVIEW))
+    del out["soak"]["slo_burn_max"]
+    with pytest.raises(ValueError, match="slo_burn_max"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["soak"]["soak_p99_ms"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["soak"]
     bench.validate_bench_output(out)
     # the plan section is optional-but-complete, arms and donation too
     out = json.load(open(PREVIEW))

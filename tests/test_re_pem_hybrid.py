@@ -109,6 +109,8 @@ def test_wind_battery_pem_against_highs():
     assert out.npv == pytest.approx(ref_npv, rel=1e-4)
 
 
+@pytest.mark.slow  # ~180 s: the full 4-tech hybrid NLP; the PEM-only
+# hybrid above keeps the wind+PEM path in tier 1
 def test_full_hybrid_structural():
     out = wind_battery_pem_tank_turb_optimize(T, _params(), verbose=True)
     sol = out.solution

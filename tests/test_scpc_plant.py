@@ -44,6 +44,8 @@ def test_scpc_without_tes_solve():
         -float(np.ravel(sol["bfp.work_mechanical"])[0]), rel=1e-9)
 
 
+@pytest.mark.slow  # ~100 s: the TES-coupled solve; the without-TES
+# solve below keeps the SCPC flowsheet path in tier 1
 def test_scpc_with_tes_solve():
     m = sp.build_scpc_flowsheet(include_concrete_tes=True)
     assert "tes" in m.units and "discharge_turbine" in m.units

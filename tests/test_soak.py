@@ -1,0 +1,636 @@
+"""Soak harness + streaming telemetry tests (ISSUE 11).
+
+Everything here runs on fake clocks — the default soak replays ~1.2k
+requests of virtual traffic in well under a second of wall time, and
+the only XLA compiles are the tiny stub-kernel programs (one per lane
+count, max_batch 8).  Coverage:
+
+* ``serve.traffic`` — deterministic arrival processes (poisson /
+  bursty MMPP-2 / diurnal thinning), spec round-trip, and the AR(1)
+  correlated parameter stream;
+* ``obs.online`` — P² quantile accuracy against the exact post-hoc
+  quantile, burn-rate rising-edge/re-arm semantics, KS drift;
+* ``obs.online.TimelineAccumulator`` — exact parity with
+  ``timeline.build_timeline`` on the same event stream (synthetic and
+  real-plan), plus the live ``plan.online.*`` gauges through
+  ``render_prometheus``;
+* ``obs.trace`` sinks — delivery, idempotent removal, exception
+  swallowing;
+* ``obs.flight`` cooldown — per-kind coalescing on an injectable
+  clock, suppressed counts carried into the next bundle, env/process
+  overrides, legacy kinds unthrottled;
+* ``obs.soak`` — the acceptance replay: >= 1000 virtual requests
+  through a real ``SolveService``, streaming p99 vs post-hoc within
+  tolerance, spike -> burn alert -> exactly one coalesced bundle, and
+  the ``--soak --json`` CLI contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.obs import export as obs_export
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import online
+from dispatches_tpu.obs import registry as reg
+from dispatches_tpu.obs import soak as obs_soak
+from dispatches_tpu.obs import timeline as obs_timeline
+from dispatches_tpu.obs import trace
+from dispatches_tpu.serve import traffic
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    trace.enable(False)
+    trace.reset()
+    obs_flight.reset()
+    yield
+    trace.enable(False)
+    trace.reset()
+    obs_flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# traffic generation
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_streams_are_deterministic():
+    spec = traffic.TrafficSpec(rate_rps=100.0, duration_s=3.0, seed=3,
+                               perturb=("price",))
+    base = {"p": {"price": np.linspace(1.0, 2.0, 4)}, "fixed": {}}
+    a = traffic.generate(spec, base)
+    b = traffic.generate(spec, base)
+    assert len(a) == len(b) > 0
+    for ra, rb in zip(a, b):
+        assert ra.t == rb.t
+        np.testing.assert_array_equal(ra.params["p"]["price"],
+                                      rb.params["p"]["price"])
+
+
+@pytest.mark.parametrize("process", traffic.PROCESSES)
+def test_arrival_processes_are_sorted_and_bounded(process):
+    spec = traffic.TrafficSpec(process=process, rate_rps=200.0,
+                               duration_s=10.0, seed=1,
+                               dwell_off_s=1.0, dwell_on_s=0.5,
+                               period_s=10.0)
+    ts = traffic.arrival_times(spec)
+    assert len(ts) > 0
+    assert np.all(np.diff(ts) >= 0)
+    assert ts[0] >= 0.0 and ts[-1] < spec.duration_s
+
+
+def test_poisson_rate_is_calibrated():
+    spec = traffic.TrafficSpec(rate_rps=500.0, duration_s=20.0, seed=0)
+    n = len(traffic.arrival_times(spec))
+    # mean 10_000, std ~100: +-5 sigma
+    assert 9_500 < n < 10_500
+
+
+def test_bursty_exceeds_baseline_count():
+    base = traffic.TrafficSpec(rate_rps=50.0, duration_s=30.0, seed=2)
+    burst = traffic.TrafficSpec(process="bursty", rate_rps=50.0,
+                                duration_s=30.0, seed=2, burst_factor=8.0,
+                                dwell_off_s=4.0, dwell_on_s=2.0)
+    # bursts only ever add arrivals over the baseline process
+    assert (len(traffic.arrival_times(burst))
+            > 1.3 * len(traffic.arrival_times(base)))
+
+
+def test_diurnal_density_follows_the_ramp():
+    spec = traffic.TrafficSpec(process="diurnal", rate_rps=200.0,
+                               duration_s=100.0, seed=4, period_s=100.0,
+                               amplitude=0.9)
+    ts = traffic.arrival_times(spec)
+    # sin > 0 over the first half-period, < 0 over the second
+    first = np.sum(ts < 50.0)
+    second = len(ts) - first
+    assert first > 1.5 * second
+
+
+def test_spec_round_trip_and_unknown_keys():
+    spec = traffic.TrafficSpec(process="bursty", rate_rps=10.0,
+                               duration_s=5.0, perturb=("price",),
+                               deadline_ms=100.0)
+    again = traffic.spec_from_dict(spec.to_dict())
+    assert again == spec
+    with pytest.raises(ValueError, match="unknown TrafficSpec keys"):
+        traffic.spec_from_dict({"rate_hz": 10.0})
+    with pytest.raises(ValueError, match="process"):
+        traffic.TrafficSpec(process="steady")
+    with pytest.raises(ValueError, match="rho"):
+        traffic.TrafficSpec(rho=1.0)
+
+
+def test_perturbed_params_ar1_stream():
+    spec = traffic.TrafficSpec(rate_rps=1.0, duration_s=1.0, seed=7,
+                               perturb=("price",), rho=0.95, sigma=0.1)
+    base = {"p": {"price": np.full(8, 10.0), "other": np.ones(3)},
+            "fixed": {"cap": 1.0}}
+    n = 4000
+    stream = traffic.perturbed_params(spec, base, n)
+    assert len(stream) == n
+    # untouched leaves pass through by reference; perturbed ones don't
+    assert stream[0]["p"]["other"] is base["p"]["other"]
+    xs = np.array([s["p"]["price"][0] / 10.0 - 1.0 for s in stream])
+    # stationary from the first draw: std ~ sigma throughout
+    assert 0.05 < np.std(xs[: n // 2]) < 0.2
+    r = np.corrcoef(xs[:-1], xs[1:])[0, 1]
+    assert r > 0.8  # strongly correlated stream, not i.i.d. redraws
+    with pytest.raises(KeyError, match="missing"):
+        traffic.perturbed_params(
+            traffic.TrafficSpec(perturb=("missing",)), base, 1)
+
+
+# ---------------------------------------------------------------------------
+# streaming quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_p2_exact_below_five_samples():
+    q = online.P2Quantile(0.5)
+    assert q.value() is None
+    for v in (5.0, 1.0, 3.0):
+        q.observe(v)
+    assert q.value() == 3.0  # exact interpolation, here the median
+
+
+@pytest.mark.parametrize("p", [0.5, 0.95, 0.99])
+def test_p2_tracks_posthoc_quantile(p):
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(mean=2.0, sigma=0.6, size=6000)
+    q = online.P2Quantile(p)
+    for x in xs:
+        q.observe(float(x))
+    exact = online.interp_quantile(sorted(float(x) for x in xs), p)
+    assert q.value() == pytest.approx(exact, rel=0.05)
+
+
+def test_streaming_quantiles_summary():
+    s = online.StreamingQuantiles()
+    assert s.summary()["count"] == 0
+    for v in range(1, 101):
+        s.observe(float(v))
+    summ = s.summary()
+    assert summ["count"] == 100
+    assert summ["min"] == 1.0 and summ["max"] == 100.0
+    assert summ["mean"] == pytest.approx(50.5)
+    assert summ["p50"] == pytest.approx(50.5, rel=0.05)
+    assert summ["p99"] == pytest.approx(99.0, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate alerting
+# ---------------------------------------------------------------------------
+
+
+def _mon(**kw):
+    kw.setdefault("rules", (online.BurnRateRule(10.0, 60.0, 2.0),))
+    kw.setdefault("check_interval_s", 0.0)
+    return online.BurnRateMonitor("lat", kind="quantile", target=100.0,
+                                  p="p99", metric="m", **kw)
+
+
+def test_burn_monitor_quiet_within_budget():
+    m = _mon()
+    for i in range(200):
+        m.observe(i * 0.5, 50.0)  # p99 = 50 -> burn 0.5
+        assert m.update(i * 0.5) == []
+    assert m.burn_peak == pytest.approx(0.5)
+
+
+def test_burn_monitor_rising_edge_and_rearm():
+    m = _mon()
+    t = 0.0
+    # fill both windows with violation (burn = 400/100 = 4 > 2)
+    alerts = []
+    while t < 120.0:
+        m.observe(t, 400.0)
+        alerts += m.update(t)
+        t += 0.5
+    assert len(alerts) == 1  # sustained violation -> ONE rising edge
+    a = alerts[0]
+    assert a["objective"] == "lat"
+    assert a["burn_fast"] > 2.0 and a["burn_slow"] > 2.0
+    assert m.burn_peak > 2.0
+    # recovery: both windows must clear before the next edge can fire
+    while t < 300.0:
+        m.observe(t, 10.0)
+        assert m.update(t) == []
+        t += 0.5
+    state = m.state(t)
+    assert all(not r["firing"] for r in state["rules"])
+    # second violation fires a second edge
+    new = []
+    while t < 420.0:
+        m.observe(t, 400.0)
+        new += m.update(t)
+        t += 0.5
+    assert len(new) == 1
+
+
+def test_burn_monitor_needs_both_windows():
+    # fast window violates, slow window is still dominated by good
+    # samples -> no alert (the SRE de-noising property)
+    m = _mon()
+    t = 0.0
+    while t < 59.5:
+        m.observe(t, 10.0)
+        m.update(t)
+        t += 0.5
+    # a single blip: the fast window's p99 (20 samples) blows through
+    # the budget, the slow window's (120 samples, < 1% bad) does not
+    m.observe(t, 400.0)
+    fired = list(m.update(t))
+    while t < 65.0:
+        t += 0.5
+        m.observe(t, 10.0)
+        fired += m.update(t)
+    assert fired == []
+    state = m.state(t)
+    fast = state["rules"][0]
+    assert fast["burn_fast"] > 2.0 > fast["burn_slow"]
+
+
+def test_monitors_from_spec_covers_objectives():
+    spec = obs_soak._slo_spec({"latency_p99_ms": 100.0,
+                               "queue_wait_p95_ms": 50.0,
+                               "deadline_miss_ratio": 0.01})
+    mons = online.monitors_from_spec(spec)
+    names = {m.name for m in mons}
+    assert names == {"soak_latency_p99", "soak_queue_wait_p95",
+                     "soak_deadline_miss_ratio"}
+    kinds = {m.name: m.kind for m in mons}
+    assert kinds["soak_deadline_miss_ratio"] == "ratio"
+
+
+# ---------------------------------------------------------------------------
+# drift detection
+# ---------------------------------------------------------------------------
+
+
+def test_ks_statistic_bounds():
+    assert online.ks_statistic([1.0, 2.0, 3.0], [1.0, 2.0, 3.0]) == 0.0
+    assert online.ks_statistic([0.0, 1.0], [10.0, 11.0]) == 1.0
+
+
+def test_drift_detector_flags_shift_only():
+    rng = np.random.default_rng(1)
+    same = online.DriftDetector(reference=200, window=200, min_samples=50)
+    for x in rng.normal(10.0, 1.0, size=600):
+        same.observe(float(x))
+    assert not same.result()["drifted"]
+    shifted = online.DriftDetector(reference=200, window=200,
+                                   min_samples=50)
+    for x in rng.normal(10.0, 1.0, size=200):
+        shifted.observe(float(x))
+    for x in rng.normal(14.0, 1.0, size=300):
+        shifted.observe(float(x))
+    res = shifted.result()
+    assert res["drifted"] and res["ks"] > res["threshold"]
+
+
+# ---------------------------------------------------------------------------
+# incremental timeline accumulator
+# ---------------------------------------------------------------------------
+
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "tid": 1, "args": args}
+
+
+def _pipeline_events(plan=1, n=4, stage=10.0, gap=40.0, fence=5.0):
+    """A synthetic dispatch-ahead stream shaped like the plan's own
+    emission order: stage+submit back-to-back, fences retiring later."""
+    evts = []
+    t = 0.0
+    for i in range(n):
+        evts.append(_span("plan.stage", t, stage, plan=plan, lanes=4))
+        evts.append(_span("plan.submit", t + stage, stage, plan=plan,
+                          seq=i, label="x", lanes=4, live=4,
+                          inflight=min(i + 1, 2)))
+        fence_t = t + 2 * stage + gap
+        evts.append(_span("plan.fence", fence_t, fence, plan=plan,
+                          seq=i, label="x", lanes=4, inflight=1))
+        t = fence_t + fence
+    return evts
+
+
+def test_accumulator_matches_build_timeline_synthetic():
+    evts = _pipeline_events()
+    acc = online.TimelineAccumulator(gauges=False)
+    for e in evts:
+        acc.ingest(e)
+    posthoc = obs_timeline.build_timeline(evts)
+    live = acc.result()
+    for key in ("plan", "n_batches", "wall_us", "host_us",
+                "hidden_host_us", "overlap_efficiency", "occupancy",
+                "occupancy_mean"):
+        assert live[key] == posthoc[key], key
+    assert live["stall"] == posthoc["stall"]
+
+
+def test_accumulator_matches_build_timeline_real_plan(monkeypatch):
+    from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+
+    trace.enable(True)
+    acc = online.TimelineAccumulator(gauges=False)
+    trace.add_sink(acc.ingest)
+    try:
+        plan = ExecutionPlan(PlanOptions(inflight=2, mesh=None,
+                                         donate=False))
+        program = plan.program(lambda x: x + 1.0, label="soak.tl",
+                               donate=False)
+        for _ in range(5):
+            staged = plan.stage(np.zeros((4, 8), np.float32), lanes=4,
+                                donate=False)
+            plan.submit(program, (staged,), n_live=4, lanes=4)
+        plan.drain()
+    finally:
+        trace.remove_sink(acc.ingest)
+    posthoc = obs_timeline.build_timeline(trace.events())
+    live = acc.result()
+    assert live["n_batches"] == posthoc["n_batches"] == 5
+    assert live["overlap_efficiency"] == posthoc["overlap_efficiency"]
+    assert live["stall"] == posthoc["stall"]
+    assert live["wall_us"] == posthoc["wall_us"]
+    assert live["occupancy"] == posthoc["occupancy"]
+
+
+def test_accumulator_ignores_foreign_plans_and_noise():
+    acc = online.TimelineAccumulator(plan=1, gauges=False)
+    acc.ingest(_span("plan.submit", 0, 10, plan=2, seq=0))  # foreign
+    acc.ingest(_span("serve.batch", 0, 10, plan=1))         # not a plan span
+    acc.ingest({"name": "plan.submit", "ph": "i", "args": {"plan": 1}})
+    assert acc.result() is None
+    acc.ingest(_span("plan.submit", 0, 10, plan=1, seq=0))
+    assert acc.result()["n_batches"] == 1
+
+
+def test_accumulator_publishes_live_gauges_through_prometheus():
+    registry = reg.MetricsRegistry()
+    acc = online.TimelineAccumulator(registry=registry)
+    for e in _pipeline_events(plan=7):
+        acc.ingest(e)
+    text = obs_export.render_prometheus(registry)
+    assert 'plan_online_overlap_efficiency{plan="7"}' in text
+    assert 'plan_online_stall_us{kind="fence_bound",plan="7"}' in text
+    assert 'plan_online_n_batches{plan="7"} 4' in text
+    # the gauge values are the accumulator's own figures
+    res = acc.result()
+    assert (registry.gauge("plan.online.overlap_efficiency").value(plan="7")
+            == res["overlap_efficiency"])
+    assert (registry.gauge("plan.online.stall_pct").value(plan="7")
+            == res["stall"]["stall_pct"])
+
+
+# ---------------------------------------------------------------------------
+# trace sinks
+# ---------------------------------------------------------------------------
+
+
+def test_trace_sinks_deliver_and_swallow():
+    seen = []
+
+    def bad(_):
+        raise RuntimeError("sink bug")
+
+    trace.enable(True)
+    trace.add_sink(seen.append)
+    trace.add_sink(seen.append)  # idempotent registration
+    trace.add_sink(bad)          # must not break recording
+    try:
+        with trace.span("solve"):
+            pass
+        trace.instant("tick")
+    finally:
+        trace.remove_sink(seen.append)
+        trace.remove_sink(seen.append)  # idempotent removal
+        trace.remove_sink(bad)
+    names = [e["name"] for e in seen]
+    assert names.count("solve") == 1 and names.count("tick") == 1
+    with trace.span("after"):
+        pass
+    assert [e["name"] for e in seen].count("after") == 0  # detached
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder cooldown
+# ---------------------------------------------------------------------------
+
+
+def test_cooldown_coalesces_and_carries_suppressed_counts(tmp_path):
+    clk = obs_soak.FakeClock()
+    obs_flight.enable(str(tmp_path))
+    obs_flight.set_clock(clk)
+    p1 = obs_flight.trigger("burn_rate", label="lat")
+    assert p1 is not None
+    for _ in range(3):  # inside the 30 s default cooldown
+        clk.advance(5.0)
+        assert obs_flight.trigger("burn_rate", label="lat") is None
+    assert obs_flight.suppressed_counts() == {"burn_rate": 3}
+    clk.advance(30.0)
+    p2 = obs_flight.trigger("burn_rate", label="lat")
+    assert p2 is not None and p2 != p1
+    assert obs_flight.load_bundle(p2)["suppressed_since_last"] == {
+        "burn_rate": 3}
+    assert obs_flight.suppressed_counts() == {}  # carried, then reset
+    assert obs_flight.load_bundle(p1)["suppressed_since_last"] == {}
+
+
+def test_cooldown_is_per_kind_and_legacy_kinds_unthrottled(tmp_path):
+    obs_flight.enable(str(tmp_path))
+    obs_flight.set_clock(obs_soak.FakeClock())
+    # event-shaped kinds keep firing back-to-back (cooldown 0)
+    paths = [obs_flight.trigger("quarantine") for _ in range(3)]
+    assert all(p is not None for p in paths)
+    # ...while burn_rate coalesces at the same timestamps
+    assert obs_flight.trigger("burn_rate") is not None
+    assert obs_flight.trigger("burn_rate") is None
+
+
+def test_cooldown_overrides(tmp_path, monkeypatch):
+    clk = obs_soak.FakeClock()
+    obs_flight.enable(str(tmp_path))
+    obs_flight.set_clock(clk)
+    # env flag overrides every kind
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN_S", "10")
+    assert obs_flight.trigger("quarantine") is not None
+    assert obs_flight.trigger("quarantine") is None
+    clk.advance(10.0)
+    assert obs_flight.trigger("quarantine") is not None
+    # process-level set_cooldown wins over the env flag
+    obs_flight.set_cooldown(0.0)
+    assert obs_flight.trigger("quarantine") is not None
+    assert obs_flight.trigger("quarantine") is not None
+    obs_flight.set_cooldown(None)  # back to the env value
+    assert obs_flight.trigger("quarantine") is None
+
+
+def test_cooldown_never_reached_when_disarmed(monkeypatch):
+    """Disarmed recorder stays zero-overhead: the cooldown clock is
+    never read (the check sits after the directory early-return)."""
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", raising=False)
+    calls = []
+
+    def spy_clock():
+        calls.append(1)
+        return 0.0
+
+    obs_flight.set_clock(spy_clock)
+    assert obs_flight.trigger("burn_rate") is None
+    assert calls == []
+    assert obs_flight.suppressed_counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# the soak replay (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_soak_replays_1000_requests_with_streaming_p99():
+    report = obs_soak.run_soak()  # DEFAULT_SPEC: ~1.2k requests, 5 s
+    c = report["requests"]
+    assert c["scheduled"] >= 1000
+    assert c["submitted"] == c["done"] == c["scheduled"]
+    assert c["timeout"] == 0
+    # virtual time elapsed, wall time didn't (this test is fast-lane)
+    assert report["duration_s"] >= 5.0
+    streaming = report["latency_ms"]["streaming"]
+    posthoc = report["latency_ms"]["posthoc"]
+    assert posthoc["count"] == c["done"]
+    # acceptance: streaming P2 p99 matches the exact post-hoc quantile
+    assert streaming["p99"] == pytest.approx(posthoc["p99"], rel=0.10)
+    assert streaming["p50"] == pytest.approx(posthoc["p50"], rel=0.05)
+    assert report["soak_p99_ms"] == streaming["p99"]
+    # in-budget run: no alerts, burn below threshold
+    assert report["slo"]["alerts_total"] == 0
+    assert 0.0 < report["slo_burn_max"] < 1.0
+    # the online timeline locked onto the service's plan
+    tl = report["timeline"]
+    assert tl is not None and tl["n_batches"] > 0
+    assert tl["stall"]["stall_pct"] <= 100.0
+    # drift: the AR(1) stream is stationary, no drift flag
+    assert not report["drift"]["latency"]["drifted"]
+
+
+def test_soak_determinism():
+    spec = {"traffic": {"duration_s": 1.0}}
+    a = obs_soak.run_soak(dict(spec))
+    b = obs_soak.run_soak(dict(spec))
+    assert a["latency_ms"]["posthoc"] == b["latency_ms"]["posthoc"]
+    assert a["requests"] == b["requests"]
+
+
+def test_soak_spike_fires_one_coalesced_bundle(tmp_path):
+    spec = {
+        "traffic": {"duration_s": 6.0, "rate_rps": 150.0},
+        # 100x service time from t=2s: p99 blows through the budget
+        "service_time": {"spikes": [[2.0, 6.0, 100.0]]},
+    }
+    report = obs_soak.run_soak(spec, flight_dir=str(tmp_path))
+    assert report["slo_burn_max"] > 1.2
+    assert report["slo"]["alerts_total"] >= 1
+    # acceptance: the sustained violation dumps EXACTLY ONE bundle
+    # (the burn_rate cooldown coalesces the re-fires)
+    assert report["slo"]["flight_bundles"] == 1
+    bundles = obs_flight.bundles(str(tmp_path))
+    assert [b["kind"] for b in bundles] == ["burn_rate"]
+    bundle = obs_flight.load_bundle(bundles[0]["path"])
+    detail = bundle["trigger"]["detail"]
+    assert detail["burn_fast"] > detail["threshold"]
+    assert bundle["trigger"]["label"].startswith("soak_")
+    # suppressed re-fires are counted for the next bundle
+    if report["slo"]["alerts_total"] > 1:
+        assert obs_flight.suppressed_counts()["burn_rate"] >= 1
+
+
+def test_soak_report_written_and_schema_stable(tmp_path):
+    spec = {"traffic": {"duration_s": 1.0},
+            "export_interval_s": 0.5}
+    report = obs_soak.run_soak(spec, out_dir=str(tmp_path))
+    assert (tmp_path / "soak_report.json").exists()
+    on_disk = json.loads((tmp_path / "soak_report.json").read_text())
+    assert on_disk["schema"] == obs_soak.SOAK_SCHEMA
+    assert set(on_disk) == set(report) - {"report_path"}
+    # the continuous exporter ticked on the virtual clock
+    assert (tmp_path / "metrics.prom").exists()
+    # spec echoed for reproducibility
+    assert on_disk["spec"]["traffic"]["duration_s"] == 1.0
+    # instruments restored after the run
+    from dispatches_tpu.serve.service import SolveService
+
+    assert "record" not in SolveService.__dict__  # sanity: instance tee
+    assert not trace._SINKS
+
+
+def test_soak_rejects_unknown_spec_sections():
+    with pytest.raises(ValueError, match="unknown soak spec sections"):
+        obs_soak.run_soak({"trafic": {}})
+
+
+def test_soak_deadlines_feed_miss_ratio():
+    spec = {
+        "traffic": {"duration_s": 2.0, "rate_rps": 100.0,
+                    "deadline_ms": 1.0},  # impossible deadline
+    }
+    report = obs_soak.run_soak(spec)
+    c = report["requests"]
+    # every request either timed out at dispatch or missed at fence
+    assert c["deadline_missed"] > 0
+    assert c["timeout"] + c["done"] == c["submitted"]
+    ratio = [o for o in report["slo"]["objectives"]
+             if o["objective"] == "soak_deadline_miss_ratio"]
+    assert ratio and ratio[0]["burn_peak"] > 1.0
+
+
+def test_soak_cli_json_contract(tmp_path, capsys, monkeypatch):
+    from dispatches_tpu.obs.__main__ import main
+
+    monkeypatch.setenv("DISPATCHES_TPU_SOAK_REPORT_DIR", str(tmp_path))
+    rc = main(["--soak", "--json", "--duration", "1"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schema"] == obs_soak.SOAK_SCHEMA
+    assert payload["virtual"] is True
+    assert payload["spec"]["traffic"]["duration_s"] == 1.0
+    assert payload["requests"]["done"] == payload["requests"]["submitted"]
+    assert payload["soak_p99_ms"] > 0
+    assert "slo_burn_max" in payload
+    # the env flag routed the report to disk; CLI echoes the path
+    assert payload["report_path"] == str(tmp_path / "soak_report.json")
+
+
+def test_soak_cli_text_report(capsys):
+    from dispatches_tpu.obs.__main__ import main
+
+    rc = main(["--soak", "--duration", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== soak report (virtual clock" in out
+    assert "latency ms (streaming P2)" in out
+    assert "soak_latency_p99" in out
+
+
+# ---------------------------------------------------------------------------
+# report spans table quantiles (satellite: --report percentiles)
+# ---------------------------------------------------------------------------
+
+
+def test_report_spans_carry_quantile_columns():
+    from dispatches_tpu.obs import report as obs_report
+
+    evts = [_span("solve", 100 * i, 1000.0 * (i + 1)) for i in range(10)]
+    agg = obs_report.aggregate_spans(evts)["solve"]
+    assert agg["p50_ms"] == pytest.approx(5.5, abs=0.01)
+    assert agg["p95_ms"] == pytest.approx(9.55, abs=0.01)
+    assert agg["p99_ms"] == pytest.approx(9.91, abs=0.01)
+    assert agg["max_ms"] == 10.0
+    text = obs_report.format_report(evts)
+    assert "p50" in text and "p95" in text and "p99" in text
+    # instants keep their minimal shape
+    agg = obs_report.aggregate_spans(
+        [{"name": "tick", "ph": "i"}])["tick"]
+    assert agg == {"count": 1}

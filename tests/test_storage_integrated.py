@@ -66,6 +66,8 @@ def test_initialized_state(model):
     assert sol["coal_heat_duty"][0] > sol["plant_heat_duty"][0]
 
 
+@pytest.mark.slow  # ~60 s: the full model_analysis optimizer run;
+# test_build_square keeps the integrated build + square solve in tier 1
 def test_main_function(model):
     # reference test_main_function (:85-100): hot_empty scenario,
     # max_power 436, LMP 22 $/MWh

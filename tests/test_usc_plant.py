@@ -80,6 +80,8 @@ def test_change_power(plant):
     fs.fix(m["boiler"].inlet_state.flow_mol, up.MAIN_FLOW)
 
 
+@pytest.mark.slow  # ~47 s: re-solves the plant at 27 MPa;
+# test_square + test_change_power keep the USC solve path in tier 1
 def test_change_pressure(plant):
     # reference test_change_pressure (:95-104): 27 MPa main steam
     m, nlp, res = plant
