@@ -102,6 +102,14 @@ REGISTERED_FLAGS = {
     "PLAN_DEVICES": "execution-plan device count for its scenario mesh "
     "(plan.PlanOptions.from_env; unset/1 = single-device placement, "
     "N > 1 builds parallel.scenario_mesh(N))",
+    "WARMSTART": "kill-switch for cross-request PDLP warm starts — ON "
+    "by default; set to 0/false to force the historical cold path "
+    "everywhere (serve.warmstart.enabled; read at bucket-build time)",
+    "WARMSTART_K": "neighbors averaged per parameter-space warm-start "
+    "retrieval (serve.warmstart.default_k; default 4)",
+    "WARMSTART_RADIUS": "normalized-RMS distance gate: neighbors "
+    "beyond it fall back to a cold start "
+    "(serve.warmstart.default_radius; default 0.25)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -168,6 +168,9 @@ CORPUS: Dict[str, Dict[str, str]] = {
             soak_dur = os.environ.get("DISPATCHES_TPU_SOAK_DURATION_S")
             soak_out = os.environ.get("DISPATCHES_TPU_SOAK_REPORT_DIR")
             cool = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN_S")
+            warm = os.environ.get("DISPATCHES_TPU_WARMSTART")
+            warm_k = os.environ.get("DISPATCHES_TPU_WARMSTART_K")
+            warm_r = os.environ.get("DISPATCHES_TPU_WARMSTART_RADIUS")
         """,
     },
     "GL008": {

@@ -102,6 +102,11 @@ GATED_METRICS = {
     # the long-churn guardrails for the serve/plan stack
     "soak_p99_ms": -1,
     "slo_burn_max": -1,
+    # bench warmstart section: warm/cold mean PDHG iterations over the
+    # AR(1) correlated replay's seeded steps.  Lower is better — a rise
+    # means cross-request warm starts stopped paying (the accuracy side
+    # is covered by the arms' obj_rel_err cross-check in the section)
+    "pdhg_iters_warm_ratio": -1,
 }
 
 _GIT_SHA: Optional[str] = None

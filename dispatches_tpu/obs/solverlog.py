@@ -25,7 +25,7 @@ and render operator-facing tables.  It is NumPy-only at import time
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -36,6 +36,10 @@ __all__ = [
     "decode_newton",
 ]
 
+# mirrors solvers.pdlp.START_KIND_NAMES (not imported: that module
+# pulls jax, and this one must stay NumPy-only for the obs CLI)
+_START_KIND_NAMES = ("cold", "exact", "neighbor")
+
 
 @dataclass
 class ConvergenceTrace:
@@ -45,6 +49,10 @@ class ConvergenceTrace:
     solver: str
     iterations: int
     columns: Dict[str, np.ndarray] = field(default_factory=dict)
+    # how the lane's iterate was seeded ("cold" | "exact" | "neighbor")
+    # — a warm-started tail reads very differently from a cold one
+    # (e.g. near-zero err at row 0), so the bundle must say which it is
+    start_kind: Optional[str] = None
 
     def __len__(self) -> int:
         return self.iterations
@@ -61,6 +69,8 @@ class ConvergenceTrace:
         out: List[Dict[str, float]] = []
         for i in range(max(0, rows - n), rows):
             row: Dict[str, float] = {"row": i}
+            if self.start_kind is not None:
+                row["start_kind"] = self.start_kind
             for name in names:
                 v = self.columns[name][i]
                 if np.issubdtype(np.asarray(v).dtype, np.integer):
@@ -133,17 +143,22 @@ def decode_pdlp(trace, result=None, lane: int = 0) -> ConvergenceTrace:
     cols = {k: _lane(trace[k], lane)
             for k in ("it", "err", "err_best", "pr", "du", "gap")}
     rows = len(cols["it"])
+    start_kind = None
     if result is not None:
         n_iters = int(_scalar(result.iters, lane))
         # one recorded row per real check; finished lanes hold `it`
         n_rows = int(np.searchsorted(cols["it"], n_iters, side="left")) + 1
         n_rows = min(max(n_rows, 1), rows)
+        sk = getattr(result, "start_kind", None)
+        if sk is not None:  # warm-capable program: label the lane
+            start_kind = _START_KIND_NAMES[int(_scalar(sk, lane))]
     else:
         n_rows = rows
     return ConvergenceTrace(
         solver="pdlp",
         iterations=n_rows,
         columns={k: v[:n_rows] for k, v in cols.items()},
+        start_kind=start_kind,
     )
 
 

@@ -198,8 +198,12 @@ def format_stats(metrics: Dict) -> str:
         )
     ws = metrics["warm_start"]
     lines.append(
-        "warm starts: {hits} hit(s) / {misses} miss(es), "
-        "{size} cached solution(s)".format(**ws)
+        "warm starts: {hits} exact hit(s) / {nb} neighbor hit(s) / "
+        "{misses} miss(es), hit rate {rate:.4f}, "
+        "{size} cached solution(s), {mp} mispredict(s)".format(
+            hits=ws["hits"], nb=ws.get("neighbor_hits", 0),
+            misses=ws["misses"], rate=ws.get("hit_rate", 0.0),
+            size=ws["size"], mp=ws.get("mispredicts", 0))
     )
     if metrics["buckets"]:
         lines.append("buckets:")

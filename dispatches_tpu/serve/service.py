@@ -42,6 +42,19 @@ solutions keyed by request fingerprint, reusing
 ``utils/checkpoint.solution_x0`` (the ``warm_start_from`` layout guard)
 to reconstitute ``x0`` — a changed model layout yields a cold start,
 never a bad vector.
+
+PDLP-path requests (service-built solvers only) get cross-request
+primal–dual starts from a per-bucket
+:class:`dispatches_tpu.serve.warmstart.WarmStartIndex`: exact
+fingerprint first, then radius-gated parameter-space k-NN, else a zero
+start — which reproduces the cold arithmetic bit-for-bit, so one
+donated ``(x0, z0, kind)`` stack carries mixed warm/cold lanes through
+a single compiled program.  ``LPResult.start_kind`` is echoed on the
+``serve.dispatch`` span, a :class:`warmstart.MispredictGuard` counts
+(and flight-records) starts that converge slower than the cold
+baseline estimate, and ``DISPATCHES_TPU_WARMSTART`` kills the whole
+feature (buckets then compile the historical single-argument program:
+zero added work on the hot path, bitwise-identical results).
 """
 
 from __future__ import annotations
@@ -73,10 +86,16 @@ from dispatches_tpu.serve.metrics import (
     QueueWaitWindow,
     format_stats,
 )
+from dispatches_tpu.serve import warmstart
 from dispatches_tpu.plan import ExecutionPlan, PlanOptions
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 from dispatches_tpu.solvers.pdlp import (
     PDLPOptions,
+    START_COLD,
+    START_EXACT,
+    START_KIND_NAMES,
+    START_NEIGHBOR,
+    make_lp_data,
     make_pdlp_solver,
     resolve_pdlp_precision,
 )
@@ -108,7 +127,10 @@ class ServeOptions:
     max_batch: int = 64        # flush threshold == max lanes per dispatch
     max_wait_ms: float = 10.0  # oldest-request age that forces a flush
     max_queue: int = 1024      # total pending bound (backpressure)
-    warm_start: bool = True    # feed cached solutions back as x0 (IPM)
+    warm_start: bool = True    # feed cached solutions back as starts
+    #                            (IPM x0 LRU + PDLP neighbor index; the
+    #                            DISPATCHES_TPU_WARMSTART kill-switch
+    #                            additionally gates the PDLP side)
     warm_cache_size: int = 512
     latency_window: int = 4096
     #: optional 1-D device mesh (``parallel.sharding.scenario_mesh``):
@@ -164,7 +186,7 @@ class SolveHandle:
 
     __slots__ = ("_service", "_bucket", "params", "x0", "submitted_at",
                  "deadline_at", "warm_key", "_result", "request_id",
-                 "_t_submit_us")
+                 "_t_submit_us", "start", "param_vec")
 
     def __init__(self, service, bucket, params, submitted_at, deadline_at,
                  request_id):
@@ -175,6 +197,10 @@ class SolveHandle:
         self.submitted_at = submitted_at
         self.deadline_at = deadline_at
         self.warm_key = None
+        #: warm-bucket (pdlp) lanes: per-lane ``(x0, z0, kind)`` start
+        #: staged at submit; ``param_vec`` feeds the neighbor index
+        self.start = None
+        self.param_vec = None
         self._result = None
         #: monotonic per-service id minted at submit; carried through
         #: queue -> dispatch -> completion and stamped on the
@@ -259,7 +285,7 @@ class _Bucket:
     ``ExecutionPlan.program``), and the pending queue."""
 
     def __init__(self, nlp, solver: str, options: Dict, label: str,
-                 plan: ExecutionPlan):
+                 plan: ExecutionPlan, warm_start: bool = False):
         self.nlp = nlp
         self.pending: "deque[SolveHandle]" = deque()
         kind = solver.lower()
@@ -268,6 +294,13 @@ class _Bucket:
         # (env override included) — telemetry for tests/stats
         self.precision = resolve_pdlp_precision(opts.get("precision"))
         base = opts.pop("base_solver", None)
+        # cross-request PDLP warm starts: only for service-built pdlp
+        # solvers (a caller-supplied base_solver has an unknown start
+        # contract), gated by the service warm_start policy AND the
+        # DISPATCHES_TPU_WARMSTART kill-switch
+        self.warm = False
+        warm_data = None
+        warm_dtype = np.float64
         if base is not None:
             # caller-built per-scenario solver (e.g. the bidder's
             # already-autoscaled IPM); caller declares the kind
@@ -277,8 +310,13 @@ class _Bucket:
             lp_kw.setdefault("tol", 1e-8)
             lp_kw.setdefault("dtype", "float64")
             try:
-                base = make_pdlp_solver(nlp, PDLPOptions(**lp_kw))
+                lp_data = make_lp_data(nlp)
+                base = make_pdlp_solver(nlp, PDLPOptions(**lp_kw),
+                                        lp_data=lp_data)
                 kind = "pdlp"
+                if warm_start:
+                    warm_data = lp_data
+                    warm_dtype = np.dtype(lp_kw["dtype"])
             except ValueError:
                 if kind != "auto":
                     raise
@@ -312,6 +350,27 @@ class _Bucket:
             # (params carry no alias-compatible output — donating them
             # would be a no-op; see docs/execution_plan.md).
             self.default_x0 = np.asarray(nlp.x0) * np.asarray(nlp.var_scale)
+            self.program = plan.program(
+                base, label=f"serve.{label}", vmap_axes=(0, 0),
+                donate_argnums=(1,) if plan.options.donate else ())
+        elif warm_data is not None:
+            # warm-capable pdlp bucket: every lane carries a
+            # (x0, z0, kind) start — cold lanes pass zeros, which
+            # reproduce the cold init arithmetic bit-for-bit, so one
+            # compiled signature serves mixed warm/cold batches.  The
+            # start stack is the donatable batch state (x0/z0/kind
+            # alias the result's x/z/start_kind buffers); params carry
+            # no alias-compatible output, exactly as on the ipm path.
+            self.default_x0 = None
+            n = int(np.asarray(warm_data["lb"]).size)
+            m = int(warm_data["K"].shape[0] + warm_data["G"].shape[0])
+            self.warm = True
+            self.warm_dtype = warm_dtype
+            self.warm_cold_start = (np.zeros(n, warm_dtype),
+                                    np.zeros(m, warm_dtype),
+                                    np.int32(START_COLD))
+            self.warm_index = warmstart.WarmStartIndex()
+            self.warm_guard = warmstart.MispredictGuard()
             self.program = plan.program(
                 base, label=f"serve.{label}", vmap_axes=(0, 0),
                 donate_argnums=(1,) if plan.options.donate else ())
@@ -350,6 +409,7 @@ class SolveService:
         self._warm = _WarmStartCache(self.options.warm_cache_size)
         self._warm_hits = 0
         self._warm_misses = 0
+        self._warm_neighbor_hits = 0
         self._submitted = 0
         self._solved = 0
         self._timeouts = 0
@@ -421,7 +481,9 @@ class SolveService:
             label = f"{solver.lower()}#{len(self._buckets)}"
             if base_solver is not None:
                 opts["base_solver"] = base_solver
-            bucket = _Bucket(nlp, solver, opts, label, self.plan)
+            bucket = _Bucket(nlp, solver, opts, label, self.plan,
+                             warm_start=(self.options.warm_start
+                                         and warmstart.enabled()))
             self._buckets[key] = bucket
         return bucket
 
@@ -472,6 +534,32 @@ class SolveService:
             handle.x0 = np.asarray(
                 bucket.default_x0 if x0 is None else x0,
                 dtype=bucket.default_x0.dtype)
+        elif bucket.warm:
+            handle.warm_key = (warm_key if warm_key is not None
+                               else (bucket.stats.label,
+                                     request_fingerprint(params)))
+            # host-side staging, outside the lock like the ipm cast
+            # above: exact fingerprint first, then radius-gated
+            # parameter-space neighbors, else a zero start (bitwise the
+            # cold init) — one donated stack carries all three kinds
+            handle.param_vec = warmstart.param_vector(params)
+            dt = bucket.warm_dtype
+            sol = bucket.warm_index.exact(handle.warm_key)
+            if sol is not None:
+                self._warm_hits += 1
+                handle.start = (np.asarray(sol[0], dt),
+                                np.asarray(sol[1], dt),
+                                np.int32(START_EXACT))
+            else:
+                nb = bucket.warm_index.nearest(handle.param_vec)
+                if nb is not None:
+                    self._warm_neighbor_hits += 1
+                    handle.start = (np.asarray(nb[0], dt),
+                                    np.asarray(nb[1], dt),
+                                    np.int32(START_NEIGHBOR))
+                else:
+                    self._warm_misses += 1
+                    handle.start = bucket.warm_cold_start
         with self._lock:
             bucket.pending.append(handle)
             bucket.stats.record_submitted()
@@ -627,6 +715,14 @@ class SolveService:
                 plan.stack([r.x0 for r in live], lanes=lanes),
                 lanes=lanes, donate=1 in argnums)
             args = (batched, x0_stack)
+        elif bucket.warm:
+            # the (x0, z0, kind) stacks are the donatable batch state:
+            # they alias the result's x/z/start_kind buffers, so XLA
+            # updates the start in place batch over batch
+            start_stack = plan.stage(
+                plan.stack([r.start for r in live], lanes=lanes),
+                lanes=lanes, donate=1 in argnums)
+            args = (batched, start_stack)
         else:
             args = (batched,)
         ticket = plan.submit(
@@ -657,6 +753,11 @@ class SolveService:
                 bucket=label, lanes=lanes, live=len(live))
         objs = np.asarray(res.obj)
         flight_on = obs_flight.enabled()
+        warm = bucket.warm
+        kinds = iters_arr = None
+        if warm:
+            kinds = np.asarray(res.start_kind).reshape(-1)
+            iters_arr = np.asarray(res.iters).reshape(-1)
         conv = None
         if flight_on:  # non-convergence trigger needs the host mask
             conv_arr = getattr(res, "converged", None)
@@ -686,7 +787,9 @@ class SolveService:
                     request_id=r.request_id, bucket=label)
                 obs_trace.complete(
                     "serve.dispatch", dispatch_us, end_us - dispatch_us,
-                    request_id=r.request_id, bucket=label, lanes=lanes)
+                    request_id=r.request_id, bucket=label, lanes=lanes,
+                    start_kind=(START_KIND_NAMES[int(kinds[i])]
+                                if kinds is not None else "cold"))
                 obs_trace.complete(
                     "serve.request", r._t_submit_us,
                     end_us - r._t_submit_us, request_id=r.request_id,
@@ -709,6 +812,33 @@ class SolveService:
                                           else bool(conv[i]))})
             if bucket.kind == "ipm" and self.options.warm_start:
                 self._warm.put(r.warm_key, bucket.nlp, lane)
+            if warm:
+                kind_i = int(kinds[i])
+                it_i = float(iters_arr[i])
+                if kind_i == START_COLD:
+                    bucket.warm_guard.observe_cold(it_i)
+                elif bucket.warm_guard.observe_warm(it_i):
+                    # mispredicted start: converged slower than the
+                    # cold baseline estimate — attributable via the
+                    # flight bundle's start_kind
+                    if flight_on:
+                        obs_flight.trigger(
+                            "warm_mispredict",
+                            request_id=r.request_id, bucket=label,
+                            label=f"serve.{label}",
+                            params_fingerprint=request_fingerprint(
+                                r.params),
+                            solver_options={"kind": bucket.kind,
+                                            "precision": bucket.precision},
+                            detail={
+                                "start_kind": START_KIND_NAMES[kind_i],
+                                "iters": it_i,
+                                "cold_iters_ema":
+                                    bucket.warm_guard.cold_iters_ema,
+                            })
+                bucket.warm_index.add(r.warm_key, r.param_vec,
+                                      np.asarray(lane.x),
+                                      np.asarray(lane.z))
         self._obs_solved.inc(len(live))
 
     # -- telemetry ---------------------------------------------------------
@@ -759,11 +889,28 @@ class SolveService:
                 "miss_rate": (self._deadline_missed / self._submitted
                               if self._submitted else 0.0),
             },
-            "warm_start": {"hits": self._warm_hits,
-                           "misses": self._warm_misses,
-                           "size": len(self._warm)},
+            "warm_start": self._warm_start_metrics(),
             "buckets": buckets,
             "cost_cards": cost_cards,
+        }
+
+    def _warm_start_metrics(self) -> Dict:
+        """hits = exact (ipm LRU + pdlp fingerprint), neighbor_hits =
+        pdlp k-NN retrievals, misses = cold starts; hit_rate over all
+        lookups; size counts LRU entries + every bucket index entry."""
+        warm_buckets = [b for b in self._buckets.values() if b.warm]
+        lookups = (self._warm_hits + self._warm_neighbor_hits
+                   + self._warm_misses)
+        return {
+            "hits": self._warm_hits,
+            "neighbor_hits": self._warm_neighbor_hits,
+            "misses": self._warm_misses,
+            "mispredicts": sum(b.warm_guard.mispredicts
+                               for b in warm_buckets),
+            "hit_rate": ((self._warm_hits + self._warm_neighbor_hits)
+                         / lookups if lookups else 0.0),
+            "size": len(self._warm) + sum(len(b.warm_index)
+                                          for b in warm_buckets),
         }
 
     def format_stats(self) -> str:

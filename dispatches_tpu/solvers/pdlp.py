@@ -58,6 +58,16 @@ _F32_INNER_TOL = 5e-6
 # 0.98 → all lanes converge, and smaller factors only add iterations.
 _HALPERN_STEP_SCALE = 0.98
 
+# Provenance of a caller-supplied primal–dual start, echoed per lane in
+# ``LPResult.start_kind`` so serve spans / convergence tails can
+# attribute a mispredicted start.  A zero-vector start with
+# ``START_COLD`` reproduces the cold init arithmetic bit-for-bit, which
+# is what lets a donated batch stack carry mixed warm/cold lanes.
+START_COLD = 0       # no reuse: the historical x=0/z=0 init
+START_EXACT = 1      # exact-key cache hit (same request fingerprint)
+START_NEIGHBOR = 2   # parameter-space k-NN retrieval (serve/warmstart)
+START_KIND_NAMES = ("cold", "exact", "neighbor")
+
 
 class LPResult(NamedTuple):
     x: jnp.ndarray          # solution in the SCALED decision space (use
@@ -77,6 +87,12 @@ class LPResult(NamedTuple):
     #                              solver — a lane that is non-converged
     #                              with refined > 0 exhausted its
     #                              refinement budget)
+    start_kind: jnp.ndarray = None  # provenance of the start this lane
+    #                                 was seeded from (START_COLD /
+    #                                 START_EXACT / START_NEIGHBOR);
+    #                                 None when the caller passed no
+    #                                 start — the pre-warm-start result
+    #                                 layout, preserved bit-for-bit
 
 
 @dataclass(frozen=True)
@@ -380,12 +396,26 @@ def make_lp_data(nlp, probe_params=None):
 
 def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
                      trace: bool = False):
-    """Build ``solver(params) -> LPResult`` for an affine CompiledNLP.
+    """Build ``solver(params, start=None) -> LPResult`` for an affine
+    CompiledNLP.
 
     The returned callable is jit/vmap-compatible; Jacobian structure is
     baked in, per-scenario ``c``/``q``/``h`` are re-derived from
     ``params`` inside the trace (cheap: one residual eval at x=0 plus
     one objective gradient).
+
+    ``start`` (optional) is a caller-supplied primal–dual warm start
+    ``(x0, z0)`` or ``(x0, z0, kind)``: ``x0`` in the CompiledNLP
+    scaled space (the space ``LPResult.x`` reports), ``z0`` in the
+    original constraint space (``LPResult.z``), ``kind`` one of
+    :data:`START_COLD` / :data:`START_EXACT` / :data:`START_NEIGHBOR`
+    (default exact), echoed in ``LPResult.start_kind``.  The start
+    seeds the iterate AND (on the halpern path) the Halpern anchor, so
+    the contraction pulls toward the reused solution rather than the
+    origin.  ``start=None`` keeps the historical cold path untouched —
+    bitwise-identical results — and a zero-vector start reproduces the
+    cold arithmetic exactly, which is what lets a donated batch stack
+    carry mixed warm/cold lanes without shape or program changes.
 
     ``trace=True`` returns ``(LPResult, trace_dict)`` where
     ``trace_dict`` holds one row per termination check (fixed length
@@ -708,10 +738,27 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
                 jax.lax.while_loop(r_cond, r_body, init_r)
             return xb, zb, pr, du, gap, rounds
 
-    def solver(params) -> LPResult:
+    def solver(params, start=None) -> LPResult:
         c, b = _rhs(params)
-        x = jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h)
-        z = jnp.zeros(m_eq + m_in, dtype)
+        if start is None:
+            # cold path: literally the historical init — callers that
+            # never pass a start get bitwise-identical results
+            x = jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h)
+            z = jnp.zeros(m_eq + m_in, dtype)
+            start_kind = None
+        else:
+            # caller-supplied primal–dual start: x0 in the CompiledNLP
+            # scaled space (LPResult.x), z0 in the original constraint
+            # space (LPResult.z).  Map both into the equilibrated space
+            # and project onto the feasible boxes; a zero start
+            # reproduces the cold arithmetic exactly, so mixed
+            # warm/cold stacks need no branching.
+            x0_in, z0_in = start[0], start[1]
+            kind = start[2] if len(start) > 2 else START_EXACT
+            x = jnp.clip(jnp.asarray(x0_in, dtype) / dc_j, lb_h, ub_h)
+            zw = jnp.asarray(z0_in, dtype) / dr_j
+            z = jnp.where(is_eq, zw, jnp.clip(zw, 0.0, None))
+            start_kind = jnp.asarray(kind, jnp.int32)
 
         # initial primal weight: in this parameterization (tau = omega/|A|,
         # sigma = 1/(omega |A|)) the primal iterate must travel ~|x*| and
@@ -1021,6 +1068,7 @@ def make_pdlp_solver(nlp, options: PDLPOptions = PDLPOptions(), lp_data=None,
             gap=gap,
             z=zb * dr_j,
             refined=refined,
+            start_kind=start_kind,
         )
         return (result, trace_rec) if trace else result
 

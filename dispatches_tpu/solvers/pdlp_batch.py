@@ -40,6 +40,7 @@ from dispatches_tpu.solvers.pdlp import (
     _HALPERN_STEP_SCALE,
     LPResult,
     PDLPOptions,
+    START_EXACT,
     _power_norm,
     _precision_plan,
     _scalings,
@@ -324,23 +325,36 @@ def _pallas_halpern_sweep_fn(Ah, AhT, lb, ub, is_eq_f, k, lanes_per_block,
 
 def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
                            lp_data=None):
-    """Build ``solver(batched_params) -> LPResult`` where every leaf of
-    ``batched_params`` that varies per scenario carries a leading batch
-    axis (broadcast leaves may stay unbatched); the result's fields all
-    carry the batch axis.
+    """Build ``solver(batched_params, start=None) -> LPResult`` where
+    every leaf of ``batched_params`` that varies per scenario carries a
+    leading batch axis (broadcast leaves may stay unbatched); the
+    result's fields all carry the batch axis.
 
     ``batched_params`` follows ``nlp.default_params()`` structure; the
     per-scenario (c, b) are derived inside the trace exactly as in
     pdlp.py (one residual eval at x=0 + one objective gradient, vmapped
     over the batch).
 
-    Donation contract (``dispatches_tpu.plan``): PDLP starts from the
-    cold x=0/z=0 iterate internally, so the call boundary carries NO
-    alias-compatible batch state — ``batched_params`` leaves do not
-    alias any output, and plan programs over this solver (and over the
-    vmapped per-scenario pdlp.py solver) must use
-    ``donate_argnums=()``.  In-place iterate reuse happens inside the
-    compiled while-loop/Pallas sweep instead."""
+    ``start`` (optional) is a per-lane primal–dual start
+    ``(x0, z0)`` or ``(x0, z0, kind)`` with ``x0`` of shape (B, n) in
+    the CompiledNLP scaled space, ``z0`` of shape (B, m) in the
+    original constraint space, and ``kind`` (B,) int32 start-kind codes
+    (see ``pdlp.START_COLD``/``START_EXACT``/``START_NEIGHBOR``),
+    echoed per lane in ``LPResult.start_kind``.  The start seeds both
+    the iterate and the per-lane Halpern anchor; zero rows reproduce
+    the cold arithmetic bit-for-bit, so one stack may mix warm and
+    cold lanes.
+
+    Donation contract (``dispatches_tpu.plan``): without a ``start``
+    argument PDLP begins from the cold x=0/z=0 iterate internally, so
+    the call boundary carries NO alias-compatible batch state —
+    ``batched_params`` leaves do not alias any output and such programs
+    must use ``donate_argnums=()``.  A warm-start program DOES carry
+    alias-compatible state: the staged ``(x0, z0, kind)`` stack has the
+    same shapes/dtypes as the result's ``(x, z, start_kind)`` fields,
+    so plan programs that pass a start should donate that argument
+    (serve builds its warm PDLP programs with ``donate_argnums=(1,)``),
+    letting XLA update the start buffers in place batch over batch."""
     opt = options
     if opt.polish:
         raise NotImplementedError(
@@ -588,7 +602,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
                 jax.lax.while_loop(r_cond, r_body, init_r)
             return xb, zb, pr, du, gap, rounds
 
-    def solver(batched_params) -> LPResult:
+    def solver(batched_params, start=None) -> LPResult:
         # batch axis = any leaf with one extra leading dim vs defaults;
         # broadcast leaves vmap with axis None
         defaults = nlp.default_params()
@@ -620,9 +634,26 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
         B = sizes.pop()
         c, b = jax.vmap(_rhs_one, in_axes=(axes,))(batched_params)
 
-        x = jnp.broadcast_to(jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h),
-                             (B, n))
-        z = jnp.zeros((B, m), dtype)
+        if start is None:
+            # cold path: literally the historical init — callers that
+            # never pass a start get bitwise-identical results
+            x = jnp.broadcast_to(jnp.clip(jnp.zeros(n, dtype), lb_h, ub_h),
+                                 (B, n))
+            z = jnp.zeros((B, m), dtype)
+            start_kind = None
+        else:
+            # per-lane primal–dual starts: x0 (B, n) in the CompiledNLP
+            # scaled space, z0 (B, m) in the original constraint space.
+            # Map into the equilibrated space and project; zero rows
+            # reproduce the cold arithmetic exactly, so one stack may
+            # mix warm and cold lanes without branching.
+            x = jnp.clip(jnp.asarray(start[0], dtype) / dc_j[None, :],
+                         lb_h[None, :], ub_h[None, :])
+            zw = jnp.asarray(start[1], dtype) / dr_j[None, :]
+            z = jnp.where(is_eq[None, :], zw, jnp.clip(zw, 0.0, None))
+            kind = (start[2] if len(start) > 2
+                    else jnp.full((B,), START_EXACT, jnp.int32))
+            start_kind = jnp.asarray(kind, jnp.int32)
 
         nb = jnp.linalg.norm(b, axis=-1)
         nc = jnp.linalg.norm(c, axis=-1)
@@ -829,6 +860,7 @@ def make_pdlp_batch_solver(nlp, options: BatchPDLPOptions = BatchPDLPOptions(),
             # extraction works identically on both paths
             z=zb * dr_j[None, :],
             refined=refined,
+            start_kind=start_kind,
         )
 
     return solver

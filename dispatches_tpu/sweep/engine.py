@@ -76,6 +76,17 @@ class SweepOptions:
     solver: Union[str, Callable] = "ipm"
     solver_options: Optional[Mapping] = None  # IPMOptions/PDLPOptions fields
     max_chunks: Optional[int] = None  # stop this run after N chunks
+    #: opt-in chunk-to-chunk warm starts (direct backend, pdlp solver):
+    #: each chunk's points are ordered by parameter distance to the
+    #: previous chunk's centroid and seeded from its recorded solutions
+    #: through the same radius-gated neighbor retrieval serve uses
+    #: (``serve/warmstart.py``; the DISPATCHES_TPU_WARMSTART
+    #: kill-switch also applies).  Off by default: warm-seeded
+    #: objectives agree with cold ones only to solver tolerance, and
+    #: the cross-backend parity suite pins near-bitwise agreement.
+    #: Retries always re-solve cold; resumed runs re-derive identical
+    #: seeds from the store, so resume convergence is preserved.
+    warm_start: bool = False
 
     @classmethod
     def from_env(cls, **overrides) -> "SweepOptions":
@@ -156,6 +167,29 @@ def _pad_rows(values: Dict[str, np.ndarray], width: int):
     return out
 
 
+def _seeds_from_prev(prev_sol, inputs: np.ndarray):
+    """Per-point ``(x0, z0, kind)`` seed stacks for one chunk, retrieved
+    from the previous chunk's solutions through the serve warm-start
+    index (same normalized k-NN + radius gate); gated-out points get
+    zero rows — bitwise the cold init."""
+    from dispatches_tpu.serve import warmstart
+    from dispatches_tpu.solvers.pdlp import START_NEIGHBOR
+
+    p_inputs, p_x, p_z = prev_sol
+    index = warmstart.WarmStartIndex(capacity=max(len(p_inputs), 1))
+    for row in range(len(p_inputs)):
+        index.add(None, p_inputs[row], p_x[row], p_z[row])
+    n_pts = len(inputs)
+    x0 = np.zeros((n_pts, p_x.shape[1]), np.float64)
+    z0 = np.zeros((n_pts, p_z.shape[1]), np.float64)
+    kind = np.zeros(n_pts, np.int32)
+    for i in range(n_pts):
+        nb = index.nearest(inputs[i])
+        if nb is not None:
+            x0[i], z0[i], kind[i] = nb[0], nb[1], START_NEIGHBOR
+    return x0, z0, kind
+
+
 def run_sweep(nlp, spec: SweepSpec, *,
               store_dir=None,
               options: Optional[SweepOptions] = None,
@@ -201,29 +235,73 @@ def run_sweep(nlp, spec: SweepSpec, *,
         # pins the tier the objectives were actually solved at
         precision = resolve_pdlp_precision(
             (opts.solver_options or {}).get("precision"))
+    warm_eff = False
+    if opts.warm_start:
+        if opts.backend.lower() != "direct":
+            raise ValueError(
+                "SweepOptions.warm_start is direct-backend only "
+                f"(got backend={opts.backend!r})")
+        if kind not in ("pdlp", "cbc"):
+            raise ValueError(
+                "SweepOptions.warm_start requires solver='pdlp' (the "
+                f"primal–dual start contract); got {opts.solver!r}")
+        from dispatches_tpu.serve import warmstart
+
+        # kill-switch resolved at plan time, like precision, and pinned
+        # in the manifest: warm-seeded chunks are not interchangeable
+        # with cold ones
+        warm_eff = warmstart.enabled()
     store = ResultStore.open_or_create(
         store_dir if store_dir is not None else opts.result_dir,
         spec, opts.chunk_size, resume=resume, overwrite=overwrite,
         backend=opts.backend, solver=kind, precision=precision,
-        params_fingerprint=request_fingerprint(defaults))
+        params_fingerprint=request_fingerprint(defaults),
+        warm_start=warm_eff)
 
     solve_chunk = _make_backend(nlp, opts, defaults, names_p, names_f,
                                 mesh=mesh, service=service, plan=plan)
 
     chunks = store.chunk_plan()
     ran = 0
+    # chunk-to-chunk warm seeding (opt-in, direct/pdlp only): the
+    # previous chunk's (inputs, x, z) — re-read from the store on
+    # resume, so a resumed run derives the exact seeds the killed run
+    # would have and converges to the same bytes
+    warm_seed = getattr(solve_chunk, "supports_seeds", False)
+    prev_sol = None
     for cid, start, stop in chunks:
         if cid in store.completed:
+            if warm_seed:
+                done = store.load_chunk(cid)
+                prev_sol = ((done["inputs"], done["x"], done["z"])
+                            if "x" in done else None)
             continue
         if opts.max_chunks is not None and ran >= opts.max_chunks:
             break
         idxs = np.arange(start, stop)
+        seeds = None
+        if warm_seed and prev_sol is not None:
+            # order this chunk's points by parameter distance to the
+            # previous chunk's centroid (deterministic: a pure function
+            # of the spec), then retrieve each point's radius-gated
+            # neighbor seed from the previous chunk's solutions
+            centroid = prev_sol[0].mean(axis=0)
+            d = np.linalg.norm(spec.inputs_for(idxs) - centroid, axis=1)
+            idxs = idxs[np.argsort(d, kind="stable")]
+            seeds = _seeds_from_prev(prev_sol, spec.inputs_for(idxs))
         values = spec.values_for(idxs)
         n_live = len(idxs)
         t0 = time.perf_counter()
         with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
-            obj, conv, iters, refined = solve_chunk(
-                values, n_live, point_ids=[int(i) for i in idxs])
+            if warm_seed:
+                obj, conv, iters, refined = solve_chunk(
+                    values, n_live, point_ids=[int(i) for i in idxs],
+                    seeds=seeds)
+                chunk_x = solve_chunk.last_x.copy()
+                chunk_z = solve_chunk.last_z.copy()
+            else:
+                obj, conv, iters, refined = solve_chunk(
+                    values, n_live, point_ids=[int(i) for i in idxs])
             # serve backend: the service request ids of this chunk's
             # points, so the quarantine path names the same id the
             # serve.request trace spans carry
@@ -250,6 +328,9 @@ def run_sweep(nlp, spec: SweepSpec, *,
                         obj[j], conv[j], iters[j] = o1[0], c1[0], i1[0]
                         refined[j] = r1[0]
                         status[j] = STATUS_RETRIED
+                        if warm_seed:  # retries re-solve cold
+                            chunk_x[j] = solve_chunk.last_x[0]
+                            chunk_z[j] = solve_chunk.last_z[0]
                         break
                 else:
                     status[j] = STATUS_QUARANTINED
@@ -285,7 +366,18 @@ def run_sweep(nlp, spec: SweepSpec, *,
                                 "obj": float(obj[j]),
                                 "refined": int(refined[j])})
             _record_point_outcomes(status)
-        store.record_chunk(cid, {
+        if warm_seed:
+            # solve order is distance-sorted for seeding, but the STORE
+            # keeps the cold layout (ascending point order within the
+            # chunk) so objectives()/training_data stay point-aligned
+            # and warm stores differ from cold ones only in values
+            back = np.argsort(idxs, kind="stable")
+            idxs = idxs[back]
+            obj, conv, iters = obj[back], conv[back], iters[back]
+            status, retries, refined = (status[back], retries[back],
+                                        refined[back])
+            chunk_x, chunk_z = chunk_x[back], chunk_z[back]
+        arrays = {
             "index": idxs.astype(np.int64),
             "obj": obj,
             "converged": conv,
@@ -294,8 +386,17 @@ def run_sweep(nlp, spec: SweepSpec, *,
             "retries": retries,
             "refined": refined,
             "inputs": spec.inputs_for(idxs),
-        }, time.perf_counter() - t0,
-            extra=_chunk_cost_telemetry(opts, n_live))
+        }
+        if warm_seed:
+            # the next chunk's seed material (and the resume source):
+            # scaled-space x / original-space z, the solver start
+            # contract's spaces
+            arrays["x"] = chunk_x
+            arrays["z"] = chunk_z
+        store.record_chunk(cid, arrays, time.perf_counter() - t0,
+                           extra=_chunk_cost_telemetry(opts, n_live))
+        if warm_seed:
+            prev_sol = (arrays["inputs"], chunk_x, chunk_z)
         ran += 1
         if on_chunk is not None:
             on_chunk(cid, len(chunks))
@@ -416,7 +517,24 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
 
         xplan = plan if plan is not None else ExecutionPlan(
             PlanOptions.from_env(mesh=mesh))
-        base, _ = _resolve_solver(nlp, opts.solver, opts.solver_options)
+        base, kind_label = _resolve_solver(nlp, opts.solver,
+                                           opts.solver_options)
+        warm_seed = False
+        if opts.warm_start:
+            from dispatches_tpu.serve import warmstart
+
+            if kind_label != "pdlp":
+                raise ValueError(
+                    "SweepOptions.warm_start requires solver='pdlp' "
+                    "(the primal–dual start contract); got "
+                    f"{opts.solver!r}")
+            warm_seed = warmstart.enabled()
+        if warm_seed:
+            from dispatches_tpu.solvers.pdlp import make_lp_data
+
+            lp = make_lp_data(nlp)
+            n_var = int(np.asarray(lp["lb"]).size)
+            m_con = int(lp["K"].shape[0] + lp["G"].shape[0])
         in_axes = {
             "p": {k: (0 if k in names_p else None) for k in defaults["p"]},
             "fixed": {k: (0 if k in names_f else None)
@@ -434,10 +552,12 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
         # applies here too.  No donation: the chunk kernel takes one
         # params pytree and carries no alias-compatible iterate state
         # at the call boundary (donating it would only warn).
-        program = xplan.program(base, label="sweep.direct",
-                                vmap_axes=(in_axes,), donate_argnums=())
+        program = xplan.program(
+            base, label="sweep.direct",
+            vmap_axes=((in_axes, 0) if warm_seed else (in_axes,)),
+            donate_argnums=())
 
-        def solve_chunk(values, n_live, point_ids=None):
+        def solve_chunk(values, n_live, point_ids=None, seeds=None):
             width = xplan.lanes_for(n_live, opts.chunk_size)
             padded = _pad_rows(values, width)
             p = dict(defaults["p"])
@@ -449,15 +569,36 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                     f[k] = v
             staged = xplan.stage({"p": p, "fixed": f}, lanes=width,
                                  donate=False, batched=batched)
+            if warm_seed:
+                # every lane carries a (x0, z0, kind) start; seedless
+                # chunks (the first, and all retries) pass zeros —
+                # bitwise the cold init — through the same program
+                if seeds is None:
+                    seeds = (np.zeros((n_live, n_var), np.float64),
+                             np.zeros((n_live, m_con), np.float64),
+                             np.zeros(n_live, np.int32))
+                start = tuple(_pad_rows(
+                    {"x0": seeds[0], "z0": seeds[1], "kind": seeds[2]},
+                    width)[k] for k in ("x0", "z0", "kind"))
+                start = xplan.stage(start, lanes=width, donate=False)
+                args = (staged, start)
+            else:
+                args = (staged,)
             ticket = xplan.submit(
-                program, (staged,), n_live=n_live, lanes=width,
+                program, args, n_live=n_live, lanes=width,
                 request_ids=(point_ids if obs_trace.enabled() else None))
             # collect() fences before _extract so the chunk timer
             # upstream measures device completion, not async dispatch
             # (points/s honesty)
-            return _extract(xplan.collect(ticket), n_live)
+            res = xplan.collect(ticket)
+            if warm_seed:
+                # seed material for the next chunk (engine records it)
+                solve_chunk.last_x = np.asarray(res.x)[:n_live]
+                solve_chunk.last_z = np.asarray(res.z)[:n_live]
+            return _extract(res, n_live)
 
         solve_chunk._graft_counter = program._graft_counter
+        solve_chunk.supports_seeds = warm_seed
         return solve_chunk
 
     if backend == "mesh":

@@ -78,7 +78,8 @@ class ResultStore:
     def create(cls, path, spec, chunk_size: int, *,
                backend: str = "direct", solver: str = "ipm",
                precision: Optional[str] = None,
-               params_fingerprint: Optional[str] = None) -> "ResultStore":
+               params_fingerprint: Optional[str] = None,
+               warm_start: bool = False) -> "ResultStore":
         """Initialise a sweep directory: full chunk plan up front (every
         chunk ``pending``) so resume only ever flips statuses.
         ``precision`` is the RESOLVED solver precision tier — part of
@@ -104,6 +105,9 @@ class ResultStore:
             "backend": backend,
             "solver": solver,
             "precision": precision,
+            # warm-seeded chunks carry extra x/z arrays AND their
+            # objectives depend on the seeding path — part of identity
+            "warm_start": bool(warm_start),
             "input_names": list(spec.input_names),
             "axes": spec.describe(),
             "chunks": chunks,
@@ -117,6 +121,7 @@ class ResultStore:
                        backend: str = "direct", solver: str = "ipm",
                        precision: Optional[str] = None,
                        params_fingerprint: Optional[str] = None,
+                       warm_start: bool = False,
                        ) -> "ResultStore":
         path = Path(path)
         if (path / _MANIFEST).is_file():
@@ -148,10 +153,18 @@ class ResultStore:
                         f"{precision!r} differs from the "
                         f"{store.precision!r} this store was created "
                         "with (objectives would mix accuracy tiers)")
+                if store.warm_start != bool(warm_start):
+                    raise ValueError(
+                        "resume refused: warm_start="
+                        f"{bool(warm_start)} differs from the "
+                        f"warm_start={store.warm_start} this store was "
+                        "created with (seeding changes the chunk "
+                        "arrays and the objective path)")
                 return store
         return cls.create(path, spec, chunk_size, backend=backend,
                           solver=solver, precision=precision,
-                          params_fingerprint=params_fingerprint)
+                          params_fingerprint=params_fingerprint,
+                          warm_start=warm_start)
 
     # -- identity / plan ---------------------------------------------------
 
@@ -162,6 +175,12 @@ class ResultStore:
     @property
     def params_fingerprint(self) -> Optional[str]:
         return self._manifest.get("params_fingerprint")
+
+    @property
+    def warm_start(self) -> bool:
+        """Whether this store's chunks were warm-seeded (False on
+        stores that predate the warm-start axis)."""
+        return bool(self._manifest.get("warm_start", False))
 
     @property
     def precision(self) -> Optional[str]:

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r10_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r11_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -51,6 +51,11 @@ def test_preview_record_passes_schema(bench):
         assert key in out["soak"]
     for key in bench.SOAK_NONNULL_KEYS:
         assert out["soak"][key] is not None
+    # the warm-start A/B (r11): measured, never null
+    for key in bench.WARMSTART_KEYS:
+        assert key in out["warmstart"]
+    for key in bench.WARMSTART_NONNULL_KEYS:
+        assert out["warmstart"][key] is not None
 
 
 def test_preview_soak_section(bench):
@@ -66,6 +71,27 @@ def test_preview_soak_section(bench):
     assert soak["slo_burn_max"] >= 0.0
     assert soak["alerts_total"] == 0
     assert soak["deadline_miss_rate"] == 0.0
+
+
+def test_preview_warmstart_ab(bench):
+    """The r11 warm-start A/B backs the cross-request warm-start
+    acceptance: on the serve-shaped replay (AR(1) drift lanes plus
+    exact-repeat lanes), seeding each step from the previous step's
+    primal-dual solutions costs at most half the cold-start PDHG
+    iterations (measured ~0.43x on the CPU preview), at an objective
+    error no worse than the cold arm's — the warm arm must never buy
+    iterations with accuracy."""
+    out = json.load(open(PREVIEW))
+    ws = out["warmstart"]
+    assert ws["lanes"] > ws["repeat_lanes"] >= 1  # mixed stream
+    assert ws["steps"] >= 2  # at least one seeded step
+    assert ws["pdhg_iters_warm_ratio"] <= 0.5
+    assert ws["pdhg_iters_warm_ratio"] == pytest.approx(
+        ws["pdhg_iters_warm_mean"] / ws["pdhg_iters_cold_mean"], abs=1e-3)
+    assert ws["obj_rel_err_warm"] <= ws["obj_rel_err_cold"]
+    # both arms inside the repo-wide objective parity budget
+    assert ws["obj_rel_err_cold"] <= 1e-4
+    assert ws["obj_rel_err_warm"] <= 1e-4
 
 
 def test_preview_pdlp_variant_ab(bench):
@@ -254,6 +280,19 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["plan"]
+    bench.validate_bench_output(out)
+    # the warm-start A/B (r11) is optional-but-complete, headline
+    # metrics non-null when the section is present
+    out = json.load(open(PREVIEW))
+    del out["warmstart"]["pdhg_iters_warm_ratio"]
+    with pytest.raises(ValueError, match="pdhg_iters_warm_ratio"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["warmstart"]["obj_rel_err_warm"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["warmstart"]
     bench.validate_bench_output(out)
 
 

@@ -304,6 +304,35 @@ def test_pdlp_trace_gap_at_reported_iteration_and_parity(
         assert float(ct["err_best"][-1]) <= opts.tol
 
 
+def test_pdlp_trace_labels_warm_start_kind():
+    """A warm-capable traced solve decodes with the lane's seeding kind
+    on the trace (and every tail row): a warm tail reads differently
+    from a cold one, so the bundle must say which it is."""
+    from dispatches_tpu.serve.__main__ import _arbitrage_nlp
+    from dispatches_tpu.solvers.pdlp import (
+        START_EXACT,
+        PDLPOptions,
+        make_pdlp_solver,
+    )
+
+    nlp = _arbitrage_nlp(6)
+    params = nlp.default_params()
+    opts = PDLPOptions(dtype="float64", tol=1e-8)
+    solver = jax.jit(make_pdlp_solver(nlp, opts, trace=True))
+    cold, tr0 = solver(params)
+    ct0 = solverlog.decode_pdlp(tr0, cold)
+    # historical single-arg call: unlabeled trace, unlabeled tail rows
+    assert ct0.start_kind is None
+    assert all("start_kind" not in row for row in ct0.tail())
+    res, tr = solver(params,
+                     (cold.x, cold.z, np.int32(START_EXACT)))
+    assert float(res.obj) == pytest.approx(float(cold.obj), rel=1e-9)
+    ct = solverlog.decode_pdlp(tr, res)
+    assert ct.start_kind == "exact"
+    tail = ct.tail()
+    assert tail and all(row["start_kind"] == "exact" for row in tail)
+
+
 def test_newton_trace_residual_and_parity():
     from dispatches_tpu.solvers.newton import make_newton_solver
 

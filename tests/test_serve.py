@@ -393,6 +393,115 @@ def test_warm_start_cache_reduces_iterations(nlp12):
     assert ws["hits"] == 1 and ws["misses"] == 1 and ws["size"] == 1
 
 
+def test_pdlp_warm_start_exact_neighbor_and_parity(nlp8, direct_pdlp8,
+                                                   monkeypatch):
+    """Cross-request pdlp warm starts: identical re-submissions exact-hit
+    the fingerprint map, small perturbations neighbor-hit the parameter
+    index, and both keep reference parity (cached/blended starts must
+    never move the converged answer past the cold tolerance)."""
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9),
+                       clock=FakeClock())
+    rng = np.random.default_rng(3)
+    plist = [_price_params(nlp8, 8, rng) for _ in range(4)]
+    opts = {"tol": 1e-9, "dtype": "float64"}
+    from dispatches_tpu.obs import trace as obs_trace
+
+    r1 = svc.solve_many(nlp8, plist, solver="pdlp", options=opts)
+    assert all(int(r.result.start_kind) == 0 for r in r1)  # cold
+    obs_trace.enable(True)
+    obs_trace.reset()
+    try:
+        # round 2: byte-identical params -> exact fingerprint hits; the
+        # solver accepts the cached optimum at the iteration-0 check
+        r2 = svc.solve_many(nlp8, plist, solver="pdlp", options=opts)
+        # round 3: 0.1% price perturbation -> inside the radius gate
+        plist3 = [{"p": {**p["p"], "price": p["p"]["price"] * 1.001},
+                   "fixed": p["fixed"]} for p in plist]
+        r3 = svc.solve_many(nlp8, plist3, solver="pdlp", options=opts)
+        evts = obs_trace.to_chrome_events()
+    finally:
+        obs_trace.enable(False)
+        obs_trace.reset()
+    assert all(int(r.result.start_kind) == 1 for r in r2)
+    assert all(int(r.result.iters) < int(a.result.iters)
+               for r, a in zip(r2, r1))
+    assert all(int(r.result.start_kind) == 2 for r in r3)
+    # the per-request dispatch spans carry the lane's seeding kind
+    kinds = [e["args"].get("start_kind") for e in evts
+             if e["name"] == "serve.dispatch"]
+    assert kinds.count("exact") == 4 and kinds.count("neighbor") == 4
+    for p, r in list(zip(plist, r2)) + list(zip(plist3, r3)):
+        assert r.status == RequestStatus.DONE
+        assert r.obj == pytest.approx(float(direct_pdlp8(p).obj), abs=1e-6)
+    ws = svc.metrics()["warm_start"]
+    assert ws["hits"] == 4 and ws["neighbor_hits"] == 4
+    assert ws["misses"] == 4
+    assert ws["hit_rate"] == pytest.approx(8 / 12)
+
+
+def test_pdlp_cold_path_bitwise_parity_with_kill_switch(nlp8, monkeypatch):
+    """Feature-off contract: first-contact (cold) lanes through the
+    warm-capable program are BITWISE identical to the kill-switched
+    single-arg program — the zero start reproduces cold arithmetic
+    exactly, so enabling the feature cannot shift any baseline."""
+    rng = np.random.default_rng(5)
+    plist = [_price_params(nlp8, 8, rng) for _ in range(4)]
+    opts = {"tol": 1e-7, "dtype": "float64"}
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    svc_on = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9),
+                          clock=FakeClock())
+    r_on = svc_on.solve_many(nlp8, plist, solver="pdlp", options=opts)
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART", "0")
+    svc_off = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9),
+                           clock=FakeClock())
+    r_off = svc_off.solve_many(nlp8, plist, solver="pdlp", options=opts)
+    for a, b in zip(r_on, r_off):
+        assert np.asarray(a.result.x).tobytes() == \
+            np.asarray(b.result.x).tobytes()
+        assert np.asarray(a.result.z).tobytes() == \
+            np.asarray(b.result.z).tobytes()
+        assert int(a.result.iters) == int(b.result.iters)
+        assert float(a.obj) == float(b.obj)
+    # the kill-switched bucket runs the historical program: no start_kind
+    assert all(int(r.result.start_kind) == 0 for r in r_on)
+    assert all(r.result.start_kind is None for r in r_off)
+
+
+def test_pdlp_warm_start_off_is_zero_overhead(nlp8, monkeypatch):
+    """Spy-pinned: with warm starts off (option or kill-switch) the
+    submit path must never touch the retrieval machinery — not even to
+    build a parameter vector.  Both spies raise, so any hot-path call
+    fails the solve."""
+    from dispatches_tpu.serve import warmstart
+
+    def _boom(*a, **k):
+        raise AssertionError("warm-start machinery touched on cold path")
+
+    rng = np.random.default_rng(9)
+    params = _price_params(nlp8, 8, rng)
+    monkeypatch.setattr(warmstart, "param_vector", _boom)
+    monkeypatch.setattr(warmstart, "WarmStartIndex", _boom)
+    # (a) per-service opt-out
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    svc = SolveService(
+        ServeOptions(max_batch=2, max_wait_ms=1e9, warm_start=False),
+        clock=FakeClock())
+    res = svc.solve(nlp8, params, solver="pdlp",
+                    options={"tol": 1e-7, "dtype": "float64"})
+    assert float(res.obj) == float(res.obj)  # finite, solve completed
+    # (b) global kill-switch with the option left on
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART", "0")
+    svc2 = SolveService(ServeOptions(max_batch=2, max_wait_ms=1e9),
+                        clock=FakeClock())
+    res2 = svc2.solve(nlp8, params, solver="pdlp",
+                      options={"tol": 1e-7, "dtype": "float64"})
+    assert float(res2.obj) == pytest.approx(float(res.obj), abs=1e-9)
+    for s in (svc, svc2):
+        ws = s.metrics()["warm_start"]
+        assert ws["hits"] == 0 and ws["neighbor_hits"] == 0
+
+
 # ---------------------------------------------------------------------
 # entry points: factory, bidder, CLI
 # ---------------------------------------------------------------------

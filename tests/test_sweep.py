@@ -295,6 +295,77 @@ def test_resume_via_max_chunks_partial_runs(nlp, tmp_path, ref_store):
     np.testing.assert_array_equal(ref_store.objectives(), st.objectives())
 
 
+# -- chunk-to-chunk warm starts ----------------------------------------
+
+
+def test_warm_sweep_objectives_match_cold_and_resume_bitwise(
+        nlp, tmp_path, ref_store, monkeypatch):
+    """Opt-in warm seeding keeps objectives at solver tolerance against
+    the cold reference, records the x/z seed material in every chunk,
+    and a killed+resumed warm run reproduces the uninterrupted warm
+    store byte-for-byte (seeds re-derived from the store)."""
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    spec = _spec()
+    warm = run_sweep(nlp, spec, store_dir=tmp_path / "warm",
+                     options=_opts(warm_start=True))
+    assert warm.is_complete and warm.warm_start is True
+    np.testing.assert_allclose(warm.objectives(), ref_store.objectives(),
+                               rtol=0, atol=1e-5)
+    # every chunk carries the seed/resume arrays
+    for cid in sorted(warm.completed):
+        done = warm.load_chunk(cid)
+        assert "x" in done and "z" in done
+
+    class Killed(RuntimeError):
+        pass
+
+    def die_after_first(cid, n_chunks):
+        raise Killed(f"killed after chunk {cid}")
+
+    with pytest.raises(Killed):
+        run_sweep(nlp, spec, store_dir=tmp_path / "warm_cut",
+                  options=_opts(warm_start=True), on_chunk=die_after_first)
+    st = run_sweep(nlp, spec, store_dir=tmp_path / "warm_cut",
+                   options=_opts(warm_start=True), resume=True)
+    assert st.is_complete
+    assert _identity_hashes(tmp_path / "warm") == _identity_hashes(
+        tmp_path / "warm_cut")
+
+
+def test_warm_sweep_kill_switch_reproduces_cold_store(
+        nlp, tmp_path, ref_store, monkeypatch):
+    """DISPATCHES_TPU_WARMSTART=0 overrides the option at plan time: the
+    run degrades to the exact cold store (no x/z arrays, manifest says
+    warm_start=False, bitwise-identical bytes)."""
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART", "0")
+    st = run_sweep(nlp, _spec(), store_dir=tmp_path / "killed",
+                   options=_opts(warm_start=True))
+    assert st.is_complete and st.warm_start is False
+    assert _identity_hashes(ref_store.path) == _identity_hashes(
+        tmp_path / "killed")
+
+
+def test_warm_sweep_resume_refuses_seeding_mismatch(nlp, ref_store,
+                                                    monkeypatch):
+    """A cold store cannot be resumed warm: seeded chunks carry extra
+    arrays and tolerance-level objective differences, so the manifest
+    pins the seeding mode."""
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    with pytest.raises(ValueError, match="warm_start"):
+        run_sweep(nlp, _spec(), store_dir=ref_store.path,
+                  options=_opts(warm_start=True), resume=True)
+
+
+def test_warm_sweep_requires_direct_pdlp(nlp, tmp_path):
+    with pytest.raises(ValueError, match="direct-backend only"):
+        run_sweep(nlp, _spec(), store_dir=tmp_path / "wb",
+                  options=_opts(warm_start=True, backend="serve"))
+    with pytest.raises(ValueError, match="pdlp"):
+        run_sweep(nlp, _spec(), store_dir=tmp_path / "ws",
+                  options=_opts(warm_start=True, solver="ipm",
+                                solver_options=None))
+
+
 # -- quarantine --------------------------------------------------------
 
 

@@ -1,0 +1,166 @@
+"""serve.warmstart: the bounded parameter-space neighbor index and the
+mispredict guard.
+
+Pinned properties: retrieval is deterministic (same insertions + same
+query ⇒ same start, bitwise), the ring stays bounded at capacity with
+the exact-key map riding the evictions, the radius gate turns far
+neighbors into cold falls-backs, and the kill-switch / tuning flags
+resolve through the registered ``DISPATCHES_TPU_WARMSTART*`` names.
+"""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.serve import warmstart
+from dispatches_tpu.serve.warmstart import MispredictGuard, WarmStartIndex
+
+
+def _fill(idx, n, d=4, seed=0, key_of=lambda i: i):
+    rng = np.random.default_rng(seed)
+    vecs = 1.0 + 0.1 * rng.standard_normal((n, d))
+    for i in range(n):
+        idx.add(key_of(i), vecs[i], np.full(3, float(i)), np.full(2, -float(i)))
+    return vecs
+
+
+# ---------------------------------------------------------------------------
+# retrieval
+# ---------------------------------------------------------------------------
+
+
+def test_nearest_is_deterministic_bitwise():
+    a, b = WarmStartIndex(capacity=64), WarmStartIndex(capacity=64)
+    _fill(a, 40)
+    _fill(b, 40)
+    q = 1.0 + 0.01 * np.arange(4)
+    ra, rb = a.nearest(q), b.nearest(q)
+    assert ra is not None
+    assert ra[0].tobytes() == rb[0].tobytes()
+    assert ra[1].tobytes() == rb[1].tobytes()
+    assert ra[2] == rb[2]
+
+
+def test_exact_lookup_returns_newest_for_key():
+    idx = WarmStartIndex(capacity=8)
+    idx.add("k", np.ones(3), np.zeros(2), np.zeros(1))
+    idx.add("k", np.ones(3) * 1.01, np.ones(2), np.ones(1))
+    x, z = idx.exact("k")
+    assert np.all(x == 1.0) and np.all(z == 1.0)
+    assert idx.exact("missing") is None
+
+
+def test_radius_gate_falls_back_to_cold():
+    idx = WarmStartIndex(capacity=8, radius=0.25)
+    idx.add(0, np.ones(4), np.zeros(3), np.zeros(2))
+    # 2x the stored vector: normalized per-dim distance 1.0 >> radius
+    assert idx.nearest(2.0 * np.ones(4)) is None
+    # a 5%-perturbed query lands inside the gate
+    hit = idx.nearest(1.05 * np.ones(4))
+    assert hit is not None and hit[2] == pytest.approx(0.05)
+
+
+def test_nearest_weights_prefer_closest_neighbor():
+    idx = WarmStartIndex(capacity=8, k=2, radius=1.0)
+    idx.add(0, np.ones(4), np.zeros(3), np.zeros(2))
+    idx.add(1, 1.2 * np.ones(4), np.ones(3), np.ones(2))
+    x, z, dist = idx.nearest(1.01 * np.ones(4))
+    # inverse-distance weighting: the 1%-away point dominates the 19%-away
+    assert dist == pytest.approx(0.01)
+    assert np.all(x < 0.1) and np.all(z < 0.1)
+
+
+def test_vector_size_change_rejected():
+    idx = WarmStartIndex(capacity=8)
+    idx.add(0, np.ones(4), np.zeros(3), np.zeros(2))
+    with pytest.raises(ValueError, match="size changed"):
+        idx.add(1, np.ones(5), np.zeros(3), np.zeros(2))
+
+
+# ---------------------------------------------------------------------------
+# eviction bounds
+# ---------------------------------------------------------------------------
+
+
+def test_ring_eviction_bounds_count_and_exact_map():
+    cap = 16
+    idx = WarmStartIndex(capacity=cap)
+    _fill(idx, 3 * cap)
+    assert len(idx) == cap
+    # the exact map rides the ring: only the newest `cap` keys resolve
+    assert len(idx._slot_of) == cap
+    for i in range(2 * cap):
+        assert idx.exact(i) is None
+    for i in range(2 * cap, 3 * cap):
+        assert idx.exact(i) is not None
+
+
+def test_eviction_keeps_readded_keys_mapping():
+    # a key re-added into a newer slot must survive the eviction of its
+    # old slot (the eviction only drops mappings that still point there)
+    idx = WarmStartIndex(capacity=2)
+    idx.add("a", np.ones(2), np.zeros(1), np.zeros(1))       # slot 0
+    idx.add("b", np.ones(2) * 1.1, np.ones(1), np.ones(1))   # slot 1
+    idx.add("a", np.ones(2) * 1.2, np.full(1, 2.0), np.full(1, 2.0))  # 0
+    # next insert evicts slot 1 ("b"); "a" maps to slot 0 and survives
+    idx.add("c", np.ones(2) * 1.3, np.full(1, 3.0), np.full(1, 3.0))  # 1
+    assert idx.exact("b") is None
+    x, _ = idx.exact("a")
+    assert float(x[0]) == 2.0
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError, match="capacity"):
+        WarmStartIndex(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# mispredict guard
+# ---------------------------------------------------------------------------
+
+
+def test_mispredict_guard_counts_slower_than_baseline():
+    g = MispredictGuard(alpha=0.5)
+    assert g.observe_warm(100) is False  # no baseline yet: never counted
+    g.observe_cold(100.0)
+    g.observe_cold(200.0)  # ema -> 150
+    assert g.cold_iters_ema == pytest.approx(150.0)
+    assert g.observe_warm(120.0) is False
+    assert g.observe_warm(180.0) is True
+    assert g.mispredicts == 1
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_semantics(monkeypatch):
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    assert warmstart.enabled() is True  # ON by default
+    for off in ("0", "false", "False", ""):
+        monkeypatch.setenv("DISPATCHES_TPU_WARMSTART", off)
+        assert warmstart.enabled() is False
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART", "1")
+    assert warmstart.enabled() is True
+
+
+def test_k_and_radius_flags(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_K", "7")
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_RADIUS", "0.5")
+    assert warmstart.default_k() == 7
+    assert warmstart.default_radius() == 0.5
+    idx = WarmStartIndex(capacity=4)
+    assert idx.k == 7 and idx.radius == 0.5
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART_K")
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART_RADIUS")
+    assert warmstart.default_k() == warmstart.DEFAULT_K
+    assert warmstart.default_radius() == warmstart.DEFAULT_RADIUS
+
+
+def test_param_vector_flattens_pytree_deterministically():
+    params = {"p": {"a": np.arange(3.0), "b": 2.0}, "fixed": {"c": [1.0, 4.0]}}
+    v1 = warmstart.param_vector(params)
+    v2 = warmstart.param_vector(params)
+    assert v1.dtype == np.float64
+    assert v1.tobytes() == v2.tobytes()
+    assert v1.size == 6
