@@ -299,6 +299,21 @@ WARMSTART_KEYS = ("lanes", "repeat_lanes", "steps", "rho", "sigma",
                   "obj_rel_err_cold", "obj_rel_err_warm")
 WARMSTART_NONNULL_KEYS = ("pdhg_iters_warm_ratio", "obj_rel_err_cold",
                           "obj_rel_err_warm")
+#: the chaos-soak A/B (ISSUE 13): the SAME virtual-clock stub replay
+#: twice — clean, then with a seeded fault scenario (transient fence
+#: faults + one persistent poison rule) armed over a mid-replay window.
+#: ``fault_recovery_rate`` is recovered/injected over the chaos arm
+#: (1.0 = every injected fault was contained by retry/bisection/no-hang
+#: handling; gated in the ledger, higher is better) and ``soak_p99_ms``
+#: is the chaos arm's streaming tail (gated as ``chaos_p99_ms``, lower
+#: is better — what the recovery ladder costs while faults fire).
+#: ``hung`` must be 0: every submitted request reached a terminal
+#: status (DONE/TIMEOUT/ERROR/SHED).
+CHAOS_KEYS = ("n_requests", "requests_done", "errors", "shed", "hung",
+              "scenario", "injected", "recovered", "plan_retries",
+              "fault_recovery_rate", "soak_p99_ms", "baseline_p99_ms",
+              "p99_ratio_chaos_vs_baseline")
+CHAOS_NONNULL_KEYS = ("fault_recovery_rate", "soak_p99_ms")
 
 
 def validate_bench_output(out):
@@ -384,6 +399,16 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench warmstart headline metrics must be measured, "
                 f"not null: {nulls}")
+    chaos = out.get("chaos")
+    if chaos is not None:
+        missing = [k for k in CHAOS_KEYS if k not in chaos]
+        if missing:
+            raise ValueError(f"bench chaos missing sub-keys: {missing}")
+        nulls = [k for k in CHAOS_NONNULL_KEYS if chaos.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench chaos headline metrics must be measured, "
+                f"not null: {nulls}")
     return out
 
 
@@ -440,6 +465,15 @@ def _finalize_output(out):
         ws = out.get("warmstart") or {}
         if ws.get("pdhg_iters_warm_ratio") is not None:
             metrics["pdhg_iters_warm_ratio"] = ws["pdhg_iters_warm_ratio"]
+        # chaos section: recovery completeness is gated (higher is
+        # better — 1.0 means nothing escaped the failure domains) and
+        # the chaos arm's tail rides as its own gated metric so fault
+        # handling can't silently get slower
+        chaos = out.get("chaos") or {}
+        if chaos.get("fault_recovery_rate") is not None:
+            metrics["fault_recovery_rate"] = chaos["fault_recovery_rate"]
+        if chaos.get("soak_p99_ms") is not None:
+            metrics["chaos_p99_ms"] = chaos["soak_p99_ms"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -1146,6 +1180,52 @@ def run_bench():
         }
     except Exception as exc:  # telemetry must never kill the headline
         out["warmstart_bench_error"] = str(exc)[:120]
+
+    # ---- chaos-soak A/B (ISSUE 13): the same virtual stub replay
+    # clean and with a fault scenario armed over a mid-replay window —
+    # transient fence faults (retry path) plus a persistent poison rule
+    # (bisection path).  Virtual clock + stub kernel, so this costs
+    # seconds on any backend; fault_recovery_rate and the chaos arm's
+    # p99 feed the gated ledger --------------------------------------
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.obs import soak as obs_soak
+
+            chaos_scenario = ("plan.fence,p=0.25,times=6,seed=7;"
+                              "plan.fence,poison_mod=37")
+            chaos_traffic = {"process": "poisson", "rate_rps": 150.0,
+                             "duration_s": 2.0, "seed": 11,
+                             "perturb": ["price"], "rho": 0.9,
+                             "sigma": 0.05}
+            base_rep = obs_soak.run_soak({"traffic": dict(chaos_traffic)})
+            chaos_rep = obs_soak.run_soak({
+                "traffic": dict(chaos_traffic),
+                "faults": {"scenario": chaos_scenario,
+                           "start_s": 0.25, "stop_s": 1.75},
+            })
+            creq = chaos_rep["requests"]
+            cfl = chaos_rep["faults"]
+            base_p99 = base_rep["soak_p99_ms"]
+            chaos_p99 = chaos_rep["soak_p99_ms"]
+            out["chaos"] = {
+                "n_requests": creq["submitted"],
+                "requests_done": creq["done"],
+                "errors": creq["error"],
+                "shed": creq["shed"],
+                "hung": creq["hung"],
+                "scenario": chaos_scenario,
+                "injected": cfl["injected"],
+                "recovered": cfl["recovered"],
+                "plan_retries": cfl["plan_retries"],
+                "fault_recovery_rate": chaos_rep["fault_recovery_rate"],
+                "soak_p99_ms": chaos_p99,
+                "baseline_p99_ms": base_p99,
+                "p99_ratio_chaos_vs_baseline": (
+                    round(chaos_p99 / base_p99, 4)
+                    if chaos_p99 and base_p99 else None),
+            }
+    except Exception as exc:
+        out["chaos_bench_error"] = str(exc)[:120]
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------

@@ -110,6 +110,27 @@ REGISTERED_FLAGS = {
     "WARMSTART_RADIUS": "normalized-RMS distance gate: neighbors "
     "beyond it fall back to a cold start "
     "(serve.warmstart.default_radius; default 0.25)",
+    "FAULTS": "arm the fault-injection layer with a scenario spec "
+    "(faults.inject; ';'-separated rules of ','-separated key=value "
+    "fields, e.g. 'plan.fence,p=0.5,times=3;plan.fence,poison_mod=37'; "
+    "unset = disarmed, zero-overhead hot paths)",
+    "PLAN_MAX_RETRIES": "execution-plan full-batch retry budget on a "
+    "dispatch/fence error before lane bisection starts "
+    "(plan.PlanOptions.from_env; default 2)",
+    "PLAN_RETRY_BACKOFF_MS": "execution-plan base backoff between "
+    "batch retries, doubled per attempt and capped at 250 ms "
+    "(plan.PlanOptions.from_env; default 5)",
+    "SERVE_SHED_QUEUE_DEPTH": "solve-service load-shedding rung: "
+    "pending-queue depth at/above which new submits complete "
+    "immediately as SHED (serve.ServeOptions.from_env; unset = "
+    "shedding off)",
+    "SERVE_DEGRADE_MISPREDICTS": "solve-service degradation rung: "
+    "consecutive warm-start mispredicts per bucket before it falls "
+    "back to cold starts (serve.ServeOptions.from_env; default 4)",
+    "SERVE_DEGRADE_REFINE_FAILS": "solve-service degradation rung: "
+    "refine-failed lanes per bf16x-f32 bucket before new submits "
+    "redirect to an f32 twin bucket (serve.ServeOptions.from_env; "
+    "default 3)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

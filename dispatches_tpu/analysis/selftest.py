@@ -171,6 +171,12 @@ CORPUS: Dict[str, Dict[str, str]] = {
             warm = os.environ.get("DISPATCHES_TPU_WARMSTART")
             warm_k = os.environ.get("DISPATCHES_TPU_WARMSTART_K")
             warm_r = os.environ.get("DISPATCHES_TPU_WARMSTART_RADIUS")
+            faults = os.environ.get("DISPATCHES_TPU_FAULTS")
+            retries = os.environ.get("DISPATCHES_TPU_PLAN_MAX_RETRIES")
+            backoff = os.environ.get("DISPATCHES_TPU_PLAN_RETRY_BACKOFF_MS")
+            shed = os.environ.get("DISPATCHES_TPU_SERVE_SHED_QUEUE_DEPTH")
+            dg_mp = os.environ.get("DISPATCHES_TPU_SERVE_DEGRADE_MISPREDICTS")
+            dg_rf = os.environ.get("DISPATCHES_TPU_SERVE_DEGRADE_REFINE_FAILS")
         """,
     },
     "GL008": {

@@ -1,0 +1,34 @@
+"""Fault injection + failure-domain tooling (see :mod:`.inject`)."""
+from dispatches_tpu.faults.inject import (  # noqa: F401
+    SITES,
+    FaultRule,
+    FaultScenario,
+    InjectedFault,
+    arm,
+    armed,
+    check,
+    clock_skew,
+    disarm,
+    injected_total,
+    note_recovered,
+    parse_scenario,
+    recovered_total,
+    reset,
+)
+
+__all__ = [
+    "SITES",
+    "FaultRule",
+    "FaultScenario",
+    "InjectedFault",
+    "arm",
+    "armed",
+    "check",
+    "clock_skew",
+    "disarm",
+    "injected_total",
+    "note_recovered",
+    "parse_scenario",
+    "recovered_total",
+    "reset",
+]

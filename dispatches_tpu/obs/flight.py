@@ -34,7 +34,9 @@ atomically (tmp + ``os.replace``) and the directory is bounded
 (:data:`MAX_BUNDLES`, oldest deleted), so the recorder is safe to leave
 armed in production.  A recorder that breaks the operation it is
 recording is worse than no recorder: every trigger swallows its own
-exceptions.
+exceptions — but each swallowed write failure increments the
+``flight.errors`` counter and emits a debug log line, so a recorder
+pointed at a dead directory is still visible to operators.
 
 ``python -m dispatches_tpu.obs --flight [--json]`` lists/inspects
 bundles.  Host-side and stdlib-only (no jax import).
@@ -44,12 +46,15 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import os
 import threading
 import time
 from typing import Dict, List, Optional
 
 from dispatches_tpu.analysis.flags import flag_name
+
+_log = logging.getLogger(__name__)
 
 __all__ = [
     "enabled",
@@ -81,6 +86,10 @@ TRIGGER_KINDS = (
     "nan_guard",
     "solver_nonconverged",
     "burn_rate",
+    "plan_error",       # batch dispatch/fence failure (serve ERROR path)
+    "warm_mispredict",  # warm start slower than the cold baseline
+    "degrade",          # a graceful-degradation rung engaged
+    "shed",             # load-shedding turned a submit away
 )
 
 #: per-kind trigger cooldown defaults (seconds).  A sustained burn-rate
@@ -90,7 +99,11 @@ TRIGGER_KINDS = (
 #: failed request/point) default to 0 so a burst of distinct failures
 #: still dumps one bundle each; ``DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN_S``
 #: (or :func:`set_cooldown`) overrides the cooldown for ALL kinds.
-DEFAULT_COOLDOWN_S: Dict[str, float] = {"burn_rate": 30.0}
+DEFAULT_COOLDOWN_S: Dict[str, float] = {"burn_rate": 30.0,
+                                        # an overload sheds every
+                                        # submit: bundle the onset,
+                                        # not the storm
+                                        "shed": 5.0}
 
 _lock = threading.Lock()
 _seq = itertools.count(1)
@@ -204,8 +217,26 @@ def trigger(kind: str, *, request_id: Optional[int] = None,
             label=label, params_fingerprint=params_fingerprint,
             solver_options=solver_options, detail=detail,
             convergence_tail=convergence_tail)
-    except Exception:
+    except Exception as exc:
+        # swallowing is the contract (a diagnostics sink must never
+        # take down the serve path) — but count and log the failure so
+        # a recorder writing into a dead directory is visible
+        _note_write_error(kind, exc)
         return None
+
+
+def _note_write_error(kind: str, exc: BaseException) -> None:
+    try:
+        from dispatches_tpu.obs import registry as _registry
+
+        _registry.counter(
+            "flight.errors", "flight-recorder bundle writes that "
+            "failed and were swallowed (kind = trigger kind)"
+        ).inc(kind=str(kind))
+    except Exception:
+        pass
+    _log.debug("flight bundle write failed for trigger %r: %r",
+               kind, exc)
 
 
 def _write_bundle(directory: str, kind: str, *, request_id, bucket, label,

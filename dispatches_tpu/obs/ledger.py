@@ -107,6 +107,13 @@ GATED_METRICS = {
     # means cross-request warm starts stopped paying (the accuracy side
     # is covered by the arms' obj_rel_err cross-check in the section)
     "pdhg_iters_warm_ratio": -1,
+    # bench chaos section (ISSUE 13): recovered/injected over the
+    # faults-armed virtual replay — any drop below 1.0 means an
+    # injected fault escaped the retry/bisection/no-hang machinery —
+    # and the chaos arm's p99, which bounds what the recovery ladder
+    # costs the tail while faults are firing
+    "fault_recovery_rate": +1,
+    "chaos_p99_ms": -1,
 }
 
 _GIT_SHA: Optional[str] = None

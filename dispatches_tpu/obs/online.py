@@ -302,6 +302,13 @@ class BurnRateMonitor:
         for w in self._windows.values():
             w.observe(t, value)
 
+    @property
+    def firing(self) -> bool:
+        """True while any rule is in its firing state (as of the last
+        ``update``) — the load-shedding hook: wire this into
+        ``SolveService.shed_signal`` to shed under sustained burn."""
+        return any(self._firing.values())
+
     def burn(self, now: float, horizon_s: float) -> Optional[float]:
         w = self._windows[horizon_s]
         if self.kind == "ratio" or self.p == "mean":

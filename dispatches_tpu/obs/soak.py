@@ -46,8 +46,10 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import online
+from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import slo as obs_slo
 from dispatches_tpu.obs import trace as obs_trace
 
@@ -209,6 +211,12 @@ DEFAULT_SPEC: Dict = {
     "burn_rules": [[2.0, 10.0, 1.5], [5.0, 30.0, 1.2]],
     "check_interval_s": 0.5,
     "export_interval_s": 5.0,
+    # chaos: a faults/inject.py scenario armed over a [start_s, stop_s)
+    # window of the replay (virtual seconds from t0; stop_s None = the
+    # whole tail), plus the service's load-shed knobs.  scenario None
+    # (the default) arms nothing — the baseline replay is untouched.
+    "faults": {"scenario": None, "start_s": 0.0, "stop_s": None,
+               "shed_queue_depth": None, "shed_on_burn": False},
 }
 
 
@@ -289,6 +297,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     spec = load_soak_spec(overrides=spec)
     tspec = traffic_mod.spec_from_dict(spec["traffic"])
     svc_cfg = spec["service"]
+    fault_cfg = spec["faults"]
+    fault_scenario = fault_cfg.get("scenario")
+    shed_depth = fault_cfg.get("shed_queue_depth")
 
     if virtual:
         clk = clock if clock is not None else FakeClock()
@@ -314,7 +325,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     service = SolveService(
         ServeOptions(max_batch=int(svc_cfg["max_batch"]),
                      max_wait_ms=float(svc_cfg["max_wait_ms"]),
-                     warm_start=False, plan=plan),
+                     warm_start=False, plan=plan,
+                     shed_queue_depth=(None if shed_depth is None
+                                       else int(shed_depth))),
         clock=clk)
 
     if nlp is None:
@@ -348,6 +361,10 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     lat_mons = [m for m in monitors if m.metric == "serve.latency_ms"]
     qw_mons = [m for m in monitors if m.metric == "serve.queue_wait_ms"]
     ratio_mons = [m for m in monitors if m.kind == "ratio"]
+    if fault_cfg.get("shed_on_burn"):
+        # sustained-burn load shedding: any monitor rule firing sheds
+        # new submissions until its windows drain back under threshold
+        service.shed_signal = lambda: any(m.firing for m in monitors)
 
     acc = online.TimelineAccumulator(plan=service.plan.plan_id)
     latencies: List[float] = []
@@ -404,7 +421,31 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     poll_dt = max(float(svc_cfg["max_wait_ms"]) / 1e3, 1e-3)
     pending: deque = deque()
     counts = {"scheduled": len(requests), "submitted": 0, "done": 0,
-              "timeout": 0, "deadline_missed": 0}
+              "timeout": 0, "error": 0, "shed": 0, "deadline_missed": 0}
+
+    # chaos bookkeeping: counter snapshots so the report reads this
+    # replay's deltas, not process-lifetime totals
+    inj0 = _faults.injected_total()
+    rec0 = _faults.recovered_total()
+    retries0 = obs_registry.counter("plan.retries").total()
+    shed0 = obs_registry.counter("serve.shed").total()
+    fault_state = {"armed": False, "restore": None, "was_armed": False}
+
+    def _fault_window(now: float) -> None:
+        """Arm the spec's scenario inside its virtual window (and put
+        back whatever was armed before once it closes)."""
+        if fault_scenario is None:
+            return
+        start = t0 + float(fault_cfg.get("start_s") or 0.0)
+        stop_s = fault_cfg.get("stop_s")
+        stop = None if stop_s is None else t0 + float(stop_s)
+        if (not fault_state["armed"] and not fault_state["was_armed"]
+                and now >= start and (stop is None or now < stop)):
+            fault_state["restore"] = _faults.arm(fault_scenario)
+            fault_state["armed"] = fault_state["was_armed"] = True
+        elif fault_state["armed"] and stop is not None and now >= stop:
+            _faults.arm(fault_state["restore"])
+            fault_state["armed"] = False
 
     def _check_alerts() -> None:
         now = clk()
@@ -418,6 +459,7 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                         bundle_paths.append(p)
 
     def _harvest() -> None:
+        _fault_window(clk())
         while pending and pending[0].done():
             h = pending.popleft()
             sr = h._result
@@ -431,6 +473,13 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                 iters = getattr(sr.result, "iters", None)
                 if iters is not None:
                     iters_drift.observe(float(iters))
+            elif sr.status == RequestStatus.ERROR:
+                counts["error"] += 1
+                missed = True
+            elif sr.status == RequestStatus.SHED:
+                # refused at submit: no latency signal, no deadline
+                # grade — the shed counter is its own SLO input
+                counts["shed"] += 1
             else:
                 counts["timeout"] += 1
                 missed = True
@@ -472,6 +521,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         if exporter is not None:
             exporter.export(now)
     finally:
+        if fault_state["armed"]:
+            _faults.arm(fault_state["restore"])
+            fault_state["armed"] = False
         service._latency.record = orig_lat
         service._queue_wait.record = orig_qw
         obs_trace.remove_sink(acc.ingest)
@@ -492,6 +544,12 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         }
     burn_max = max((m.burn_peak for m in monitors), default=0.0)
     lat_summary = lat_stream.summary()
+    injected = _faults.injected_total() - inj0
+    recovered = _faults.recovered_total() - rec0
+    recovery_rate = (recovered / injected) if injected else 1.0
+    terminal = (counts["done"] + counts["timeout"] + counts["error"]
+                + counts["shed"])
+    counts["hung"] = counts["submitted"] - terminal
     report = {
         "schema": SOAK_SCHEMA,
         "virtual": bool(virtual),
@@ -511,8 +569,22 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                   "pdhg_iters": iters_drift.result()},
         "timeline": acc.result(),
         "service": service.metrics(),
+        "faults": {
+            "armed": fault_state["was_armed"],
+            "scenario": (str(fault_scenario)
+                         if isinstance(fault_scenario, str)
+                         else fault_scenario),
+            "injected": int(injected),
+            "recovered": int(recovered),
+            "plan_retries": int(
+                obs_registry.counter("plan.retries").total() - retries0),
+            "shed": int(
+                obs_registry.counter("serve.shed").total() - shed0),
+            "recovery_rate": round(recovery_rate, 6),
+        },
         "soak_p99_ms": lat_summary.get("p99"),
         "slo_burn_max": round(burn_max, 4),
+        "fault_recovery_rate": round(recovery_rate, 6),
     }
     if out_dir:
         import os
@@ -534,7 +606,15 @@ def format_soak_report(report: Dict) -> str:
     c = report["requests"]
     lines.append(
         f"requests: {c['submitted']} submitted, {c['done']} done, "
-        f"{c['timeout']} timeout, {c['deadline_missed']} deadline-missed")
+        f"{c['timeout']} timeout, {c.get('error', 0)} error, "
+        f"{c.get('shed', 0)} shed, {c['deadline_missed']} deadline-missed")
+    fl = report.get("faults")
+    if fl and fl.get("armed"):
+        lines.append(
+            f"faults: {fl['injected']} injected, {fl['recovered']} "
+            f"recovered (rate {fl['recovery_rate']:.3f}), "
+            f"{fl['plan_retries']} plan retr{'y' if fl['plan_retries'] == 1 else 'ies'}, "
+            f"{fl['shed']} shed")
     s = report["latency_ms"]["streaming"]
     ph = report["latency_ms"]["posthoc"]
 

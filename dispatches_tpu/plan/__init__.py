@@ -6,9 +6,11 @@ See :mod:`dispatches_tpu.plan.execution` and docs/execution_plan.md.
 
 from dispatches_tpu.plan.execution import (
     ExecutionPlan,
+    PlanError,
     PlanOptions,
     PlanProgram,
     PlanTicket,
 )
 
-__all__ = ["ExecutionPlan", "PlanOptions", "PlanProgram", "PlanTicket"]
+__all__ = ["ExecutionPlan", "PlanError", "PlanOptions", "PlanProgram",
+           "PlanTicket"]

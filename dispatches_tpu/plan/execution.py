@@ -47,16 +47,34 @@ every emission site is behind the one cached ``obs_trace.enabled()``
 boolean, so the hot path pays nothing (the spy-pinned contract in
 ``tests/test_timeline_export.py``).
 
-See ``docs/execution_plan.md`` for the lifecycle and donation rules.
+The plan is also the batch **failure domain**: a dispatch or fence
+error (device fault, injected fault, solver blow-up) is wrapped into a
+:class:`PlanError` carried on the ticket instead of escaping to the
+caller mid-pipeline.  When the submitter provided a ``restage``
+callback, the plan first retries the whole batch with capped
+exponential backoff (``PlanOptions.max_retries``), then **lane-bisects**
+— split the batch, re-dispatch halves, O(log n) — until the guilty
+lanes are isolated; innocents get real results, guilty lanes are
+NaN-filled and named in ``PlanError.guilty`` so serve can fail exactly
+those requests (``RequestStatus.ERROR``) while their batchmates solve.
+``plan.retries`` counts every recovery re-dispatch.  Fault-injection
+sites (``plan.stage`` / ``plan.submit`` / ``plan.fence`` / ``solver``,
+see :mod:`dispatches_tpu.faults`) are behind one cached ``armed()``
+branch, so the disarmed hot path is unchanged.
+
+See ``docs/execution_plan.md`` for the lifecycle and donation rules and
+``docs/robustness.md`` for retry/bisection semantics.
 """
 
 from __future__ import annotations
 
 import itertools
 import os
+import threading
+import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Deque, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,10 +82,16 @@ import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 
-__all__ = ["PlanOptions", "PlanProgram", "PlanTicket", "ExecutionPlan"]
+__all__ = ["PlanOptions", "PlanProgram", "PlanTicket", "PlanError",
+           "ExecutionPlan"]
+
+#: exponential backoff between batch retries is capped here so a deep
+#: retry budget cannot stall the fence for seconds
+_BACKOFF_CAP_MS = 250.0
 
 
 @dataclass(frozen=True)
@@ -88,6 +112,12 @@ class PlanOptions:
     #: default donation policy for ``program()`` — donate the staged
     #: batch state so solver iterates update in place.
     donate: bool = True
+    #: full-batch retry budget on a dispatch/fence error before lane
+    #: bisection starts (needs a ``restage`` callback at submit time).
+    max_retries: int = 2
+    #: base backoff between batch retries in milliseconds, doubled per
+    #: attempt and capped at :data:`_BACKOFF_CAP_MS`.
+    retry_backoff_ms: float = 5.0
 
     @classmethod
     def from_env(cls, **overrides) -> "PlanOptions":
@@ -100,8 +130,41 @@ class PlanOptions:
         raw = os.environ.get(flag_name("PLAN_DEVICES"), "")
         if raw:
             env["devices"] = int(raw)
+        raw = os.environ.get(flag_name("PLAN_MAX_RETRIES"), "")
+        if raw:
+            env["max_retries"] = int(raw)
+        raw = os.environ.get(flag_name("PLAN_RETRY_BACKOFF_MS"), "")
+        if raw:
+            env["retry_backoff_ms"] = float(raw)
         env.update(overrides)
         return cls(**env)
+
+
+class PlanError(RuntimeError):
+    """A batch dispatch/fence failure wrapped with its blast radius.
+
+    Carried on the ticket (``ticket.error``) rather than raised
+    mid-pipeline.  ``guilty`` names the lane indices (positions within
+    the live batch, not request ids) whose isolated re-dispatch still
+    failed — empty means the batch fully recovered on retry.  When no
+    results could be produced at all (no ``restage`` callback, or every
+    lane guilty), ``collect()`` raises this error."""
+
+    def __init__(self, label: str, seq: int, guilty: Sequence[int] = (),
+                 attempts: int = 0, cause: Optional[BaseException] = None):
+        self.label = label
+        self.seq = seq
+        self.guilty = tuple(guilty)
+        self.attempts = attempts
+        self.cause = cause
+        msg = f"plan batch {label!r} seq {seq} failed"
+        if attempts:
+            msg += f" after {attempts} retr{'y' if attempts == 1 else 'ies'}"
+        if self.guilty:
+            msg += f"; guilty lanes {list(self.guilty)}"
+        if cause is not None:
+            msg += f" (cause: {cause!r})"
+        super().__init__(msg)
 
 
 class PlanProgram:
@@ -144,10 +207,15 @@ class PlanTicket:
 
     ``seq`` is the batch's per-plan sequence number and ``request_ids``
     the serve request ids riding it — both stamped on the lifecycle
-    spans so a request's journey joins the batch that executed it."""
+    spans so a request's journey joins the batch that executed it.
+
+    ``error`` is the :class:`PlanError` left by fence-time recovery
+    (None on the happy path); a non-empty ``error.guilty`` names the
+    lanes whose slots in ``result`` are NaN-filled."""
 
     __slots__ = ("label", "lanes", "n_live", "seq", "request_ids",
-                 "result", "_raw", "_done", "_on_done", "_t_dispatch_us")
+                 "result", "error", "_raw", "_exc", "_restage",
+                 "_program", "_done", "_on_done", "_t_dispatch_us")
 
     def __init__(self, label: str, lanes: int, n_live: int, on_done,
                  seq: int = 0, request_ids: Optional[List[int]] = None):
@@ -157,7 +225,11 @@ class PlanTicket:
         self.seq = seq
         self.request_ids = request_ids
         self.result = None
+        self.error = None
         self._raw = None
+        self._exc = None
+        self._restage = None
+        self._program = None
         self._done = False
         self._on_done = on_done
         self._t_dispatch_us = 0.0
@@ -176,6 +248,20 @@ def _stack_leaves(leaves: Sequence) -> Any:
     if any(isinstance(leaf, jax.Array) for leaf in leaves):
         return jnp.stack([jnp.asarray(leaf) for leaf in leaves])
     return np.stack([np.asarray(leaf) for leaf in leaves])
+
+
+def _nan_like_lane(lane) -> Any:
+    """Filler for a guilty lane's slot in a recovered batch result:
+    NaN for float leaves (so downstream non-finite handling fires),
+    zero/False otherwise.  Shaped from an innocent lane's slice."""
+
+    def fill(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return np.zeros_like(arr)
+
+    return jax.tree_util.tree_map(fill, lane)
 
 
 # process-wide plan ids: every ExecutionPlan stamps its id on the
@@ -212,6 +298,11 @@ class ExecutionPlan:
         self.plan_id = next(_plan_ids)
         self._seq = itertools.count(1)
         self._window: Deque[PlanTicket] = deque()
+        # dispatch/fence window guard: serve's concurrent submitters
+        # reach plan.submit/collect from multiple threads, and the
+        # FIFO window + exactly-once fence bookkeeping must not race.
+        # Host-side staging (the expensive part) stays outside it.
+        self._lock = threading.RLock()
         self._gauge = obs_registry.gauge(
             "plan.inflight",
             "execution-plan batches dispatched but not yet fenced")
@@ -219,6 +310,10 @@ class ExecutionPlan:
         self._obs_batches = obs_registry.counter(
             "plan.batches", "batches dispatched through the execution "
             "plan (label = program)")
+        self._obs_retries = obs_registry.counter(
+            "plan.retries", "recovery re-dispatches after a batch "
+            "dispatch/fence error — full-batch retries and bisection "
+            "subsets alike (label = program)")
 
     # -- placement ---------------------------------------------------------
 
@@ -283,6 +378,8 @@ class ExecutionPlan:
         holds."""
         tracing = obs_trace.enabled()
         t0_us = obs_trace.now_us() if tracing else 0.0
+        if _faults.armed():
+            _faults.check("plan.stage")
         shard = self.sharding_for(lanes)
         repl = self.replicated_sharding()
 
@@ -335,7 +432,8 @@ class ExecutionPlan:
     def submit(self, program: PlanProgram, args: Tuple, *,
                n_live: int, lanes: int,
                on_done: Optional[Callable[[PlanTicket], None]] = None,
-               request_ids: Optional[List[int]] = None) -> PlanTicket:
+               request_ids: Optional[List[int]] = None,
+               restage: Optional[Callable] = None) -> PlanTicket:
         """Dispatch one staged batch asynchronously.
 
         Returns immediately with a ticket; when the in-flight window is
@@ -344,54 +442,101 @@ class ExecutionPlan:
         at fence time with the completed ticket.  ``request_ids``
         (serve) ride the ticket onto its ``plan.submit`` /
         ``plan.dispatch`` spans, joining each request's journey to the
-        batch that executed it."""
+        batch that executed it.
+
+        ``restage`` arms fence-time recovery: a callable mapping a
+        tuple of live-lane indices to ``(args, lanes, request_ids)``
+        for that subset, re-staged **from host data** (a donating
+        program has consumed the original staged buffers by the time a
+        retry runs).  Without it a failed batch carries a
+        :class:`PlanError` covering every lane and ``collect()``
+        raises."""
         tracing = obs_trace.enabled()
-        ticket = PlanTicket(program.label, lanes, n_live, on_done,
-                            seq=next(self._seq), request_ids=request_ids)
-        ticket._t_dispatch_us = obs_trace.now_us() if tracing else 0.0
-        ticket._raw = program._run(*args)
-        self._window.append(ticket)
-        if tracing:
-            # host dispatch cost only: _run returned, nothing fenced yet
-            end_us = obs_trace.now_us()
-            args_kw = dict(plan=self.plan_id, seq=ticket.seq,
-                           label=ticket.label, lanes=lanes, live=n_live,
-                           inflight=len(self._window))
-            if request_ids is not None:
-                args_kw["request_ids"] = list(request_ids)
-            obs_trace.complete("plan.submit", ticket._t_dispatch_us,
-                               end_us - ticket._t_dispatch_us, **args_kw)
-        self._obs_batches.inc(label=program.label)
-        self._gauge.set(float(len(self._window)))
-        window = max(int(self.options.inflight), 1)
-        while len(self._window) > window:
-            self._complete_oldest()
-        return ticket
+        with self._lock:
+            ticket = PlanTicket(program.label, lanes, n_live, on_done,
+                                seq=next(self._seq),
+                                request_ids=request_ids)
+            ticket._program = program
+            ticket._restage = restage
+            ticket._t_dispatch_us = obs_trace.now_us() if tracing else 0.0
+            try:
+                if _faults.armed():
+                    _faults.check("plan.submit", label=program.label,
+                                  request_ids=request_ids)
+                    _faults.check("solver", label=program.label,
+                                  request_ids=request_ids)
+                ticket._raw = program._run(*args)
+            except Exception as exc:  # noqa: BLE001 — recovery at fence
+                ticket._exc = exc
+            self._window.append(ticket)
+            if tracing:
+                # host dispatch cost only: _run returned, nothing fenced
+                end_us = obs_trace.now_us()
+                args_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                               label=ticket.label, lanes=lanes,
+                               live=n_live, inflight=len(self._window))
+                if request_ids is not None:
+                    args_kw["request_ids"] = list(request_ids)
+                obs_trace.complete("plan.submit", ticket._t_dispatch_us,
+                                   end_us - ticket._t_dispatch_us,
+                                   **args_kw)
+            self._obs_batches.inc(label=program.label)
+            self._gauge.set(float(len(self._window)))
+            window = max(int(self.options.inflight), 1)
+            while len(self._window) > window:
+                self._complete_oldest()
+            return ticket
 
     def collect(self, ticket: PlanTicket):
         """Fence batches (oldest first) until this ticket completes;
-        returns its result pytree (device computation finished)."""
+        returns its result pytree (device computation finished).
+
+        A batch that failed and could not produce any results (no
+        ``restage`` callback, or every lane guilty) raises its
+        :class:`PlanError` here; a partially recovered batch returns a
+        result whose guilty lanes (``ticket.error.guilty``) are
+        NaN-filled, which downstream non-finite handling (the sweep's
+        point-wise retry/quarantine) already knows how to treat."""
         while not ticket._done:
-            if not self._window:
-                raise RuntimeError(
-                    f"ticket for {ticket.label!r} is neither in flight "
-                    "nor complete — was it submitted through this plan?")
-            self._complete_oldest()
+            with self._lock:
+                if ticket._done:  # fenced by a concurrent collector
+                    break
+                if not self._window:
+                    raise RuntimeError(
+                        f"ticket for {ticket.label!r} is neither in "
+                        "flight nor complete — was it submitted "
+                        "through this plan?")
+                self._complete_oldest()
+        if ticket.result is None and ticket.error is not None:
+            raise ticket.error
         return ticket.result
 
     def drain(self) -> int:
         """Fence every in-flight batch; returns how many were fenced."""
         n = 0
-        while self._window:
-            self._complete_oldest()
-            n += 1
+        with self._lock:
+            while self._window:
+                self._complete_oldest()
+                n += 1
         return n
 
     def _complete_oldest(self) -> PlanTicket:
+        # callers (submit/collect/drain) hold the window lock; keep it
+        # for the whole fence + recovery + on_done so a ticket observed
+        # popped is always observed completed (no-hang under threads)
         ticket = self._window.popleft()
         tracing = obs_trace.enabled()
         t_fence_us = obs_trace.now_us() if tracing else 0.0
-        ticket.result = jax.block_until_ready(ticket._raw)
+        try:
+            if ticket._exc is not None:
+                exc, ticket._exc = ticket._exc, None
+                raise exc
+            if _faults.armed():
+                _faults.check("plan.fence", label=ticket.label,
+                              request_ids=ticket.request_ids)
+            ticket.result = jax.block_until_ready(ticket._raw)
+        except Exception as exc:  # noqa: BLE001 — the failure domain
+            self._recover(ticket, exc)
         ticket._raw = None
         ticket._done = True
         self._gauge.set(float(len(self._window)))
@@ -415,3 +560,82 @@ class ExecutionPlan:
         if ticket._on_done is not None:
             ticket._on_done(ticket)
         return ticket
+
+    # -- failure domain ----------------------------------------------------
+
+    def _redispatch(self, ticket: PlanTicket, idxs: Sequence[int]):
+        """Synchronously re-stage and re-run a subset of a failed
+        batch.  The fault sites are re-checked here so persistent
+        (poison) rules keep failing until bisection has isolated their
+        lanes, while transient rules with an exhausted fire budget let
+        the retry through."""
+        self._obs_retries.inc(label=ticket.label)
+        args, lanes, req_ids = ticket._restage(tuple(idxs))
+        if _faults.armed():
+            for site in ("plan.submit", "solver", "plan.fence"):
+                _faults.check(site, label=ticket.label,
+                              request_ids=req_ids)
+        return jax.block_until_ready(ticket._program._run(*args))
+
+    def _recover(self, ticket: PlanTicket, exc: BaseException) -> None:
+        """Contain one failed batch: full retries with capped
+        exponential backoff, then lane bisection (split, re-dispatch
+        halves, O(log n)) to isolate guilty lanes.  Leaves
+        ``ticket.error`` (always) and ``ticket.result`` (unless no lane
+        could produce one)."""
+        label = ticket.label
+        if ticket._restage is None or ticket._program is None:
+            # no host-side restage contract: nothing to retry with —
+            # the error covers the whole batch and collect() raises it
+            ticket.error = PlanError(
+                label, ticket.seq, guilty=tuple(range(ticket.n_live)),
+                attempts=0, cause=exc)
+            return
+        _faults.note_recovered(exc)
+        indices = list(range(ticket.n_live))
+        backoff_ms = max(float(self.options.retry_backoff_ms), 0.0)
+        attempts = 0
+        for attempt in range(1, max(int(self.options.max_retries), 0) + 1):
+            attempts = attempt
+            if backoff_ms > 0.0:
+                time.sleep(min(backoff_ms * 2.0 ** (attempt - 1),
+                               _BACKOFF_CAP_MS) / 1e3)
+            try:
+                res = self._redispatch(ticket, indices)
+            except Exception as exc2:  # noqa: BLE001
+                _faults.note_recovered(exc2)
+                continue
+            ticket.result = res
+            ticket.error = PlanError(label, ticket.seq, guilty=(),
+                                     attempts=attempts, cause=exc)
+            return
+        # retries exhausted: bisect so every innocent lane still
+        # completes and only the guilty ones fail
+        results: Dict[int, Any] = {}
+        guilty: List[int] = []
+        stack = [indices]
+        while stack:
+            idxs = stack.pop()
+            try:
+                res = self._redispatch(ticket, idxs)
+            except Exception as exc2:  # noqa: BLE001
+                _faults.note_recovered(exc2)
+                if len(idxs) == 1:
+                    guilty.append(idxs[0])
+                else:
+                    mid = len(idxs) // 2
+                    stack.append(idxs[mid:])
+                    stack.append(idxs[:mid])
+                continue
+            for j, i in enumerate(idxs):
+                results[i] = jax.tree_util.tree_map(
+                    lambda a, _j=j: a[_j], res)
+        guilty.sort()
+        if results:
+            filler = _nan_like_lane(next(iter(results.values())))
+            lanes_out = [results.get(i, filler) for i in indices]
+            ticket.result = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+                *lanes_out)
+        ticket.error = PlanError(label, ticket.seq, guilty=tuple(guilty),
+                                 attempts=attempts, cause=exc)

@@ -95,6 +95,7 @@ class BucketStats:
         # so skip the label formatting Counter.inc would redo each call
         self._cells = {event: self._events.labeled(event=event)
                        for event in ("submitted", "solved", "timeout",
+                                     "error", "shed",
                                      "batch", "live", "lanes")}
         self.lane_counts: List[int] = []  # distinct padded widths seen
 
@@ -109,6 +110,12 @@ class BucketStats:
 
     def record_timeout(self) -> None:
         self._cells["timeout"].inc()
+
+    def record_error(self) -> None:
+        self._cells["error"].inc()
+
+    def record_shed(self) -> None:
+        self._cells["shed"].inc()
 
     def record_batch(self, n_live: int, lanes: int) -> None:
         self._cells["batch"].inc()
@@ -128,6 +135,14 @@ class BucketStats:
     @property
     def timeouts(self) -> int:
         return self._count("timeout")
+
+    @property
+    def errors(self) -> int:
+        return self._count("error")
+
+    @property
+    def shed(self) -> int:
+        return self._count("shed")
 
     @property
     def batches(self) -> int:
@@ -154,6 +169,8 @@ class BucketStats:
             "submitted": self.submitted,
             "solved": self.solved,
             "timeouts": self.timeouts,
+            "errors": self.errors,
+            "shed": self.shed,
             "batches": self.batches,
             "lane_counts": sorted(self.lane_counts),
             "occupancy": (round(self.occupancy, 4)

@@ -55,6 +55,31 @@ a single compiled program.  ``LPResult.start_kind`` is echoed on the
 baseline estimate, and ``DISPATCHES_TPU_WARMSTART`` kills the whole
 feature (buckets then compile the historical single-argument program:
 zero added work on the hot path, bitwise-identical results).
+
+Failure domains
+---------------
+No handle ever hangs: every dispatch-path exception (staging, plan
+submit, fence — injected or real) completes all affected handles with
+the terminal ``RequestStatus.ERROR``.  Batches ride the plan's retry +
+lane-bisection recovery (``docs/robustness.md``): each dispatch passes
+a ``restage`` callback that rebuilds any lane subset from host data,
+so a transient fault retries invisibly while a poisoned lane fails
+alone (``PlanError.guilty``) and its batchmates still solve.  On top
+sits a graceful-degradation ladder, each rung counted
+(``serve.degrade`` / ``serve.shed``) and flight-recorded:
+
+1. **warm→cold** — ``degrade_mispredicts`` consecutive warm-start
+   mispredicts demote a bucket to cold starts;
+2. **bf16→f32** — ``degrade_refine_fails`` refine-failed lanes on a
+   ``bf16x-f32`` bucket redirect new submissions to an f32 twin;
+3. **load shedding** — at/above ``shed_queue_depth`` pending requests
+   (or while the injectable ``shed_signal`` fires, e.g. the soak
+   harness's burn-rate monitors), new submits complete immediately
+   with ``RequestStatus.SHED`` instead of deepening the queue.
+
+All of it is spy-pinned zero-overhead when disarmed/disabled: the
+fault sites hide behind one cached ``faults.armed()`` branch and the
+ladder rungs behind plain attribute checks.
 """
 
 from __future__ import annotations
@@ -71,6 +96,7 @@ import jax
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import export as obs_export
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import registry as obs_registry
@@ -118,6 +144,14 @@ class RequestStatus:
     QUEUED = "QUEUED"
     DONE = "DONE"
     TIMEOUT = "TIMEOUT"
+    #: terminal: the request's dispatch failed (its lane was isolated
+    #: as guilty by plan bisection, or the whole batch's dispatch path
+    #: raised) — the no-hang contract completes the handle instead of
+    #: stranding its waiter
+    ERROR = "ERROR"
+    #: terminal: load-shed at submit (queue depth / burn signal) —
+    #: the request was never queued
+    SHED = "SHED"
 
 
 @dataclass(frozen=True)
@@ -154,6 +188,15 @@ class ServeOptions:
     #: both.  The RESOLVED tier is folded into the bucket fingerprint,
     #: so bf16 and f32 requests never share a compiled program.
     pdlp_precision: Optional[str] = None
+    #: load-shedding rung: pending-queue depth at/above which new
+    #: submits complete immediately as ``SHED`` (None = shedding off).
+    shed_queue_depth: Optional[int] = None
+    #: degradation rung 1: consecutive warm-start mispredicts per
+    #: bucket before it falls back to cold starts.
+    degrade_mispredicts: int = 4
+    #: degradation rung 2: refine-failed lanes per ``bf16x-f32`` bucket
+    #: before new submissions redirect to an f32 twin bucket.
+    degrade_refine_fails: int = 3
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeOptions":
@@ -169,6 +212,15 @@ class ServeOptions:
         raw = os.environ.get(flag_name("SERVE_MAX_QUEUE"), "")
         if raw:
             env["max_queue"] = int(raw)
+        raw = os.environ.get(flag_name("SERVE_SHED_QUEUE_DEPTH"), "")
+        if raw:
+            env["shed_queue_depth"] = int(raw)
+        raw = os.environ.get(flag_name("SERVE_DEGRADE_MISPREDICTS"), "")
+        if raw:
+            env["degrade_mispredicts"] = int(raw)
+        raw = os.environ.get(flag_name("SERVE_DEGRADE_REFINE_FAILS"), "")
+        if raw:
+            env["degrade_refine_fails"] = int(raw)
         env.update(overrides)
         return cls(**env)
 
@@ -288,6 +340,15 @@ class _Bucket:
                  plan: ExecutionPlan, warm_start: bool = False):
         self.nlp = nlp
         self.pending: "deque[SolveHandle]" = deque()
+        # graceful-degradation ladder state (docs/robustness.md):
+        # rung 1 — consecutive warm mispredicts demote to cold starts;
+        # rung 2 — refine-failed lanes redirect new submissions to an
+        # f32 twin bucket (``rebuild`` holds the constructor args)
+        self.warm_consec_mispredicts = 0
+        self.warm_fallback = False
+        self.refine_fails = 0
+        self.redirect: Optional["_Bucket"] = None
+        self.rebuild = None
         kind = solver.lower()
         opts = dict(options or {})
         # resolved at bucket-build time, like the kernels themselves
@@ -413,7 +474,13 @@ class SolveService:
         self._submitted = 0
         self._solved = 0
         self._timeouts = 0
+        self._errors = 0
+        self._shed = 0
         self._flushes = 0
+        #: injectable shed signal (e.g. the soak harness wires burn-
+        #: rate monitors here): while it returns True, new submits
+        #: complete immediately as SHED.  None = one `is None` check.
+        self.shed_signal: Optional[Callable[[], bool]] = None
         self._deadline_requests = 0   # submitted with a deadline
         self._deadline_missed = 0     # TIMEOUT or completed past deadline
         self._request_seq = itertools.count(1)
@@ -424,6 +491,14 @@ class SolveService:
         self._obs_submitted = _requests.labeled(event="submitted")
         self._obs_solved = _requests.labeled(event="solved")
         self._obs_timeout = _requests.labeled(event="timeout")
+        self._obs_error = _requests.labeled(event="error")
+        self._obs_shed_evt = _requests.labeled(event="shed")
+        self._obs_shed = obs_registry.counter(
+            "serve.shed", "requests load-shed at submit "
+            "(queue-depth / burn-signal rung; label = bucket)")
+        self._obs_degrade = obs_registry.counter(
+            "serve.degrade", "graceful-degradation rungs engaged "
+            "(rung=warm_cold|precision; label = bucket)")
         self._obs_batches = obs_registry.counter(
             "serve.batches", "solve-service batches dispatched")
         _deadline = obs_registry.counter(
@@ -481,13 +556,27 @@ class SolveService:
             label = f"{solver.lower()}#{len(self._buckets)}"
             if base_solver is not None:
                 opts["base_solver"] = base_solver
+            warm = self.options.warm_start and warmstart.enabled()
             bucket = _Bucket(nlp, solver, opts, label, self.plan,
-                             warm_start=(self.options.warm_start
-                                         and warmstart.enabled()))
+                             warm_start=warm)
+            bucket.rebuild = (nlp, solver, dict(opts), warm)
             self._buckets[key] = bucket
+        # degradation rung 2 (bf16→f32) leaves a redirect on the
+        # original bucket: new submissions follow it, in-flight
+        # requests finish on the program they were queued for
+        while bucket.redirect is not None:
+            bucket = bucket.redirect
         return bucket
 
     # -- submission --------------------------------------------------------
+
+    def _now(self) -> float:
+        """Service clock read, plus any armed ``service.clock`` fault
+        skew (the disarmed path is one cached-boolean branch)."""
+        now = self._clock()
+        if _faults.armed():
+            now += _faults.clock_skew()
+        return now
 
     def submit(self, nlp, params=None, x0=None, *, solver: str = "auto",
                options: Optional[Dict] = None,
@@ -502,15 +591,24 @@ class SolveService:
         raising.  ``base_solver`` lets a caller supply its own
         per-scenario ``solve(params, x0)`` callable (bucketed by
         identity) instead of having the service build one.
+
+        When the load-shedding rung is armed (``shed_queue_depth`` /
+        ``shed_signal``) and fires, the handle completes immediately
+        with ``RequestStatus.SHED`` — the request is never queued.
         """
-        now = self._clock()
+        now = self._now()
         self.poll(now)
         params = nlp.default_params() if params is None else params
         bucket = self._bucket_for(nlp, solver, options, params, base_solver)
+        deadline_at = None if deadline_ms is None else now + deadline_ms / 1e3
+        shed_depth = self.options.shed_queue_depth
+        if ((shed_depth is not None
+             and self._queue_depth() >= shed_depth)
+                or (self.shed_signal is not None and self.shed_signal())):
+            return self._shed_request(bucket, params, now, deadline_at)
         while self._queue_depth() >= self.options.max_queue:
             if self._flush_oldest() == 0:
                 break  # nothing pending anywhere (max_queue == 0 edge)
-        deadline_at = None if deadline_ms is None else now + deadline_ms / 1e3
         handle = SolveHandle(self, bucket, params, now, deadline_at,
                              next(self._request_seq))
         if deadline_at is not None:
@@ -534,6 +632,11 @@ class SolveService:
             handle.x0 = np.asarray(
                 bucket.default_x0 if x0 is None else x0,
                 dtype=bucket.default_x0.dtype)
+        elif bucket.warm and bucket.warm_fallback:
+            # degradation rung 1: repeated mispredicts demoted this
+            # bucket to cold starts (zeros = the cold init arithmetic,
+            # bit-for-bit) — no index lookups, no write-back
+            handle.start = bucket.warm_cold_start
         elif bucket.warm:
             handle.warm_key = (warm_key if warm_key is not None
                                else (bucket.stats.label,
@@ -572,6 +675,38 @@ class SolveService:
             self._exporter.maybe_export(self._clock())
         return handle
 
+    def _shed_request(self, bucket: _Bucket, params, now: float,
+                      deadline_at: Optional[float]) -> SolveHandle:
+        """Load-shedding rung: complete a request as ``SHED`` at submit
+        time, before it ever deepens the queue."""
+        label = bucket.stats.label
+        handle = SolveHandle(self, bucket, params, now, deadline_at,
+                             next(self._request_seq))
+        handle._complete(ServeResult(RequestStatus.SHED, None, None, 0.0))
+        with self._lock:
+            bucket.stats.record_submitted()
+            bucket.stats.record_shed()
+            self._submitted += 1
+            self._shed += 1
+        self._obs_submitted.inc()
+        self._obs_shed_evt.inc()
+        self._obs_shed.inc(bucket=label)
+        if obs_trace.enabled():
+            t_us = obs_trace.now_us()
+            obs_trace.complete(
+                "serve.request", handle._t_submit_us,
+                t_us - handle._t_submit_us, request_id=handle.request_id,
+                bucket=label, status=RequestStatus.SHED)
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "shed", request_id=handle.request_id, bucket=label,
+                label=f"serve.{label}",
+                solver_options={"kind": bucket.kind,
+                                "precision": bucket.precision},
+                detail={"queue_depth": self._queue_depth(),
+                        "shed_queue_depth": self.options.shed_queue_depth})
+        return handle
+
     def solve(self, nlp, params=None, x0=None, **submit_kw):
         """Blocking single solve through the service; returns the raw
         lane result (LPResult/IPMResult), so reference-style drivers are
@@ -597,7 +732,7 @@ class SolveService:
     def poll(self, now: Optional[float] = None) -> int:
         """Flush every bucket whose oldest request exceeded max_wait_ms;
         returns the number of requests dispatched or timed out."""
-        now = self._clock() if now is None else now
+        now = self._now() if now is None else now
         wait_s = self.options.max_wait_ms / 1e3
         n = 0
         for bucket in list(self._buckets.values()):
@@ -663,7 +798,7 @@ class SolveService:
             self._flushes += 1
             requests = [bucket.pending.popleft() for _ in range(n)]
         self._obs_queue_depth.set(float(self._queue_depth()))
-        now = self._clock()
+        now = self._now()
         tracing = obs_trace.enabled()
         label = bucket.stats.label
         live: List[SolveHandle] = []
@@ -702,43 +837,154 @@ class SolveService:
             self._queue_wait.record(label, wait_ms)
             bucket.obs_queue_wait.observe(wait_ms)
         plan = self.plan
-        lanes = plan.lanes_for(len(live), self.options.max_batch)
-        # host-side staging: stack on the host, one transfer per leaf,
-        # placed (and made donation-safe) by the plan; the padded lanes
-        # repeat the last live request's params
         argnums = bucket.program.donate_argnums
-        batched = plan.stage(
-            plan.stack([r.params for r in live], lanes=lanes),
-            lanes=lanes, donate=0 in argnums)
-        if bucket.kind == "ipm":
-            x0_stack = plan.stage(
-                plan.stack([r.x0 for r in live], lanes=lanes),
-                lanes=lanes, donate=1 in argnums)
-            args = (batched, x0_stack)
-        elif bucket.warm:
-            # the (x0, z0, kind) stacks are the donatable batch state:
-            # they alias the result's x/z/start_kind buffers, so XLA
-            # updates the start in place batch over batch
-            start_stack = plan.stage(
-                plan.stack([r.start for r in live], lanes=lanes),
-                lanes=lanes, donate=1 in argnums)
-            args = (batched, start_stack)
-        else:
-            args = (batched,)
-        ticket = plan.submit(
-            bucket.program, args, n_live=len(live), lanes=lanes,
-            on_done=lambda t: self._complete_batch(
-                bucket, live, lanes, dispatch_us, t.result),
-            # request ids ride the plan lifecycle spans so a request's
-            # journey joins the batch that executed it (obs.timeline)
-            request_ids=([r.request_id for r in live] if tracing
-                         else None))
+        max_batch = self.options.max_batch
+
+        def _stage_subset(subset: Sequence[SolveHandle]):
+            """Stack + place one lane subset from host data (handles
+            keep their params/x0/start after dispatch, so fence-time
+            recovery can always rebuild — donation only ever consumed
+            the plan-staged copies)."""
+            lanes_s = plan.lanes_for(len(subset), max_batch)
+            batched = plan.stage(
+                plan.stack([r.params for r in subset], lanes=lanes_s),
+                lanes=lanes_s, donate=0 in argnums)
+            if bucket.kind == "ipm":
+                stack = plan.stage(
+                    plan.stack([r.x0 for r in subset], lanes=lanes_s),
+                    lanes=lanes_s, donate=1 in argnums)
+                return (batched, stack), lanes_s
+            if bucket.warm:
+                # the (x0, z0, kind) stacks are the donatable batch
+                # state: they alias the result's x/z/start_kind
+                # buffers, so XLA updates the start in place
+                stack = plan.stage(
+                    plan.stack([r.start for r in subset], lanes=lanes_s),
+                    lanes=lanes_s, donate=1 in argnums)
+                return (batched, stack), lanes_s
+            return (batched,), lanes_s
+
+        def _restage(idxs):
+            sub = [live[i] for i in idxs]
+            args_s, lanes_s = _stage_subset(sub)
+            return args_s, lanes_s, [r.request_id for r in sub]
+
+        faults_armed = _faults.armed()
+        try:
+            if faults_armed:
+                _faults.check("serve.stage", label=f"serve.{label}",
+                              request_ids=[r.request_id for r in live])
+            # host-side staging: stack on the host, one transfer per
+            # leaf, placed (and made donation-safe) by the plan; the
+            # padded lanes repeat the last live request's params
+            args, lanes = _stage_subset(live)
+            ticket = plan.submit(
+                bucket.program, args, n_live=len(live), lanes=lanes,
+                on_done=lambda t: self._complete_batch(
+                    bucket, live, lanes, dispatch_us, t),
+                # request ids ride the plan lifecycle spans so a
+                # request's journey joins the batch that executed it
+                # (obs.timeline) — and, when faults are armed, let
+                # poison rules target their lanes
+                request_ids=([r.request_id for r in live]
+                             if tracing or faults_armed else None),
+                restage=_restage)
+        except Exception as exc:  # noqa: BLE001 — no-hang contract
+            _faults.note_recovered(exc)
+            self._fail_requests(bucket, live, exc)
+            return n, None
         return n, ticket
 
+    def _fail_requests(self, bucket: _Bucket,
+                       requests: Sequence[SolveHandle], exc) -> None:
+        """No-hang guarantee: every handle of a failed dispatch path
+        completes with a terminal ``ERROR`` instead of stranding its
+        waiter."""
+        end = self._clock()
+        tracing = obs_trace.enabled()
+        label = bucket.stats.label
+        for r in requests:
+            self._complete_error(bucket, r, end, tracing)
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "plan_error", bucket=label, label=f"serve.{label}",
+                solver_options={"kind": bucket.kind,
+                                "precision": bucket.precision},
+                detail={"error": repr(exc),
+                        "request_ids": [r.request_id for r in requests]})
+
+    def _complete_error(self, bucket: _Bucket, r: SolveHandle,
+                        end: float, tracing: bool) -> None:
+        latency = (end - r.submitted_at) * 1e3
+        r._complete(ServeResult(RequestStatus.ERROR, None, None, latency))
+        bucket.stats.record_error()
+        self._errors += 1
+        self._obs_error.inc()
+        if tracing:
+            t_us = obs_trace.now_us()
+            obs_trace.complete(
+                "serve.request", r._t_submit_us, t_us - r._t_submit_us,
+                request_id=r.request_id, bucket=bucket.stats.label,
+                status=RequestStatus.ERROR)
+
+    def _degrade_warm(self, bucket: _Bucket) -> None:
+        """Degradation rung 1: demote a bucket to cold starts after
+        repeated consecutive warm-start mispredicts."""
+        if bucket.warm_fallback:
+            return
+        bucket.warm_fallback = True
+        label = bucket.stats.label
+        self._obs_degrade.inc(rung="warm_cold", bucket=label)
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "degrade", bucket=label, label=f"serve.{label}",
+                solver_options={"kind": bucket.kind,
+                                "precision": bucket.precision},
+                detail={"rung": "warm_cold",
+                        "consecutive_mispredicts":
+                            bucket.warm_consec_mispredicts})
+
+    def _degrade_precision(self, bucket: _Bucket) -> None:
+        """Degradation rung 2: repeated refine-fails mean the bf16
+        inner tier cannot certify this workload — build an f32 twin
+        bucket and redirect new submissions to it (in-flight requests
+        finish on the program they were queued for)."""
+        if bucket.redirect is not None or bucket.rebuild is None:
+            return
+        if resolve_pdlp_precision("f32") != "f32":
+            return  # env pinned the tier; there is nothing to fall to
+        nlp, solver, opts, warm = bucket.rebuild
+        opts = dict(opts)
+        opts["precision"] = "f32"
+        label = f"{bucket.stats.label}.f32"
+        twin = _Bucket(nlp, solver, opts, label, self.plan,
+                       warm_start=warm)
+        twin.rebuild = (nlp, solver, opts, warm)
+        bucket.redirect = twin
+        # the twin must be a first-class bucket: poll/flush_all/
+        # queue-depth walk _buckets, and a redirect target they cannot
+        # see would strand its queue (the no-hang contract)
+        self._buckets[("degraded", label)] = twin
+        self._obs_degrade.inc(rung="precision", bucket=bucket.stats.label)
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "degrade", bucket=bucket.stats.label,
+                label=f"serve.{bucket.stats.label}",
+                solver_options={"kind": bucket.kind,
+                                "precision": bucket.precision},
+                detail={"rung": "precision", "to": "f32",
+                        "refine_fails": bucket.refine_fails})
+
     def _complete_batch(self, bucket: _Bucket, live: List[SolveHandle],
-                        lanes: int, dispatch_us: float, res) -> None:
+                        lanes: int, dispatch_us: float, ticket) -> None:
         """Fence-time bookkeeping for one dispatched batch (runs from
-        the plan's ``on_done``, after device completion)."""
+        the plan's ``on_done``, after device completion).
+
+        The ticket carries the plan's recovery verdict: ``error`` is
+        None on the happy path; with a result, ``error.guilty`` names
+        the lanes bisection could not save (those requests complete
+        with ``ERROR``, their batchmates normally); with no result at
+        all, every handle fails — never hangs."""
         tracing = obs_trace.enabled()
         label = bucket.stats.label
         bucket.stats.record_batch(len(live), lanes)
@@ -751,19 +997,54 @@ class SolveService:
             obs_trace.complete(
                 "serve.batch", dispatch_us, end_us - dispatch_us,
                 bucket=label, lanes=lanes, live=len(live))
+        res = ticket.result
+        err = ticket.error
+        if res is None:
+            cause = err.cause if err is not None else RuntimeError(
+                "batch completed with no result")
+            self._fail_requests(bucket, live, cause)
+            return
+        guilty = frozenset(err.guilty) if err is not None else frozenset()
         objs = np.asarray(res.obj)
         flight_on = obs_flight.enabled()
-        warm = bucket.warm
+        warm = bucket.warm and not bucket.warm_fallback
         kinds = iters_arr = None
         if warm:
             kinds = np.asarray(res.start_kind).reshape(-1)
             iters_arr = np.asarray(res.iters).reshape(-1)
+        # rung-2 detection: a refine-failed lane exhausted its
+        # refinement budget without certifying (finite but ~converged)
+        refine_watch = (bucket.precision == "bf16x-f32"
+                        and bucket.redirect is None)
         conv = None
-        if flight_on:  # non-convergence trigger needs the host mask
+        if flight_on or warm or refine_watch:
             conv_arr = getattr(res, "converged", None)
             if conv_arr is not None:
                 conv = np.asarray(conv_arr).reshape(-1)
+        refined = None
+        if refine_watch and conv is not None:
+            refined_arr = getattr(res, "refined", None)
+            if refined_arr is not None:
+                refined = np.asarray(refined_arr).reshape(-1)
+        n_done = 0
         for i, r in enumerate(live):
+            if i in guilty:
+                # the plan's bisection isolated this lane as guilty:
+                # its slot in `res` is NaN filler, its batchmates are
+                # real — fail exactly this request
+                self._complete_error(bucket, r, end, tracing)
+                if flight_on:
+                    obs_flight.trigger(
+                        "plan_error", request_id=r.request_id,
+                        bucket=label, label=f"serve.{label}",
+                        params_fingerprint=request_fingerprint(r.params),
+                        solver_options={"kind": bucket.kind,
+                                        "precision": bucket.precision},
+                        detail={"lane": i,
+                                "error": (repr(err.cause)
+                                          if err is not None else None)})
+                continue
+            n_done += 1
             lane = jax.tree_util.tree_map(lambda a, _i=i: a[_i], res)
             latency = (end - r.submitted_at) * 1e3
             r._complete(ServeResult(
@@ -810,7 +1091,14 @@ class SolveService:
                             "converged": (None if conv is None
                                           or i >= conv.size
                                           else bool(conv[i]))})
-            if bucket.kind == "ipm" and self.options.warm_start:
+            if (refined is not None and i < conv.size
+                    and not bool(conv[i]) and i < refined.size
+                    and float(refined[i]) > 0):
+                bucket.refine_fails += 1
+                if bucket.refine_fails >= self.options.degrade_refine_fails:
+                    self._degrade_precision(bucket)
+            if (bucket.kind == "ipm" and self.options.warm_start
+                    and np.isfinite(objs[i])):
                 self._warm.put(r.warm_key, bucket.nlp, lane)
             if warm:
                 kind_i = int(kinds[i])
@@ -821,6 +1109,7 @@ class SolveService:
                     # mispredicted start: converged slower than the
                     # cold baseline estimate — attributable via the
                     # flight bundle's start_kind
+                    bucket.warm_consec_mispredicts += 1
                     if flight_on:
                         obs_flight.trigger(
                             "warm_mispredict",
@@ -836,10 +1125,22 @@ class SolveService:
                                 "cold_iters_ema":
                                     bucket.warm_guard.cold_iters_ema,
                             })
-                bucket.warm_index.add(r.warm_key, r.param_vec,
-                                      np.asarray(lane.x),
-                                      np.asarray(lane.z))
-        self._obs_solved.inc(len(live))
+                    if (bucket.warm_consec_mispredicts
+                            >= self.options.degrade_mispredicts):
+                        self._degrade_warm(bucket)
+                else:
+                    # a warm start that paid off resets the streak
+                    bucket.warm_consec_mispredicts = 0
+                # only converged, finite lanes may seed future starts:
+                # a diverged or refine-failed solution in the neighbor
+                # index would mispredict every retrieval near it
+                if ((conv is None or (i < conv.size and bool(conv[i])))
+                        and np.isfinite(objs[i])
+                        and r.param_vec is not None):
+                    bucket.warm_index.add(r.warm_key, r.param_vec,
+                                          np.asarray(lane.x),
+                                          np.asarray(lane.z))
+        self._obs_solved.inc(n_done)
 
     # -- telemetry ---------------------------------------------------------
 
@@ -869,6 +1170,8 @@ class SolveService:
             "submitted": self._submitted,
             "solved": self._solved,
             "timeouts": self._timeouts,
+            "errors": self._errors,
+            "shed": self._shed,
             "queue_depth": self._queue_depth(),
             "flushes": self._flushes,
             "batches": sum(b.stats.batches for b in self._buckets.values()),

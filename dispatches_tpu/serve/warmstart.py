@@ -130,8 +130,19 @@ class WarmStartIndex:
         ``key`` is the exact-match fingerprint (may be None to skip the
         exact map); ``vec`` the parameter vector; ``x``/``z`` the
         solution in the solver start contract's spaces (scaled-space x,
-        original-space z — exactly what ``LPResult`` reports)."""
+        original-space z — exactly what ``LPResult`` reports).
+
+        Non-finite entries anywhere in ``vec``/``x``/``z`` drop the
+        insert: a NaN objective or diverged iterate must never seed a
+        future warm start (it would poison every neighbor within the
+        radius), so the index defends itself even if a caller forgets
+        the convergence gate."""
         vec = np.asarray(vec, np.float64).ravel()
+        x = np.asarray(x)
+        z = np.asarray(z)
+        if not (np.all(np.isfinite(vec)) and np.all(np.isfinite(x))
+                and np.all(np.isfinite(z))):
+            return
         if self._vecs is None:
             self._vecs = np.zeros((self.capacity, vec.size), np.float64)
             self._scale = np.maximum(np.abs(vec), 1e-12)

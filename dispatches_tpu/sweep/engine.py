@@ -46,7 +46,9 @@ import jax
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.plan import PlanError
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.bucket import pad_lanes, request_fingerprint
@@ -153,6 +155,13 @@ def _extract(res, n_live: int):
         refined = (np.full(n_live, int(rf)) if rf.ndim == 0
                    else rf[:n_live]).astype(np.int64)
     return obj, conv, iters, refined
+
+
+def _failed_chunk(n_live: int):
+    """The all-lanes-failed grade: non-finite objectives, nothing
+    converged — exactly what the pointwise retry loop keys on."""
+    return (np.full(n_live, np.nan), np.zeros(n_live, bool),
+            np.zeros(n_live, np.int64), np.zeros(n_live, np.int64))
 
 
 def _pad_rows(values: Dict[str, np.ndarray], width: int):
@@ -293,15 +302,26 @@ def run_sweep(nlp, spec: SweepSpec, *,
         n_live = len(idxs)
         t0 = time.perf_counter()
         with obs_trace.span("sweep.chunk", chunk=int(cid), points=int(n_live)):
-            if warm_seed:
-                obj, conv, iters, refined = solve_chunk(
-                    values, n_live, point_ids=[int(i) for i in idxs],
-                    seeds=seeds)
-                chunk_x = solve_chunk.last_x.copy()
-                chunk_z = solve_chunk.last_z.copy()
-            else:
-                obj, conv, iters, refined = solve_chunk(
-                    values, n_live, point_ids=[int(i) for i in idxs])
+            try:
+                if warm_seed:
+                    obj, conv, iters, refined = solve_chunk(
+                        values, n_live, point_ids=[int(i) for i in idxs],
+                        seeds=seeds)
+                    chunk_x = solve_chunk.last_x.copy()
+                    chunk_z = solve_chunk.last_z.copy()
+                else:
+                    obj, conv, iters, refined = solve_chunk(
+                        values, n_live, point_ids=[int(i) for i in idxs])
+            except PlanError:
+                # every lane guilty (plan retry + bisection found no
+                # innocents): grade the whole chunk non-finite so each
+                # point rides the pointwise retry → quarantine machinery
+                # below instead of crashing the sweep
+                obj, conv, iters, refined = _failed_chunk(n_live)
+                if warm_seed:
+                    n_var_ws, m_con_ws = solve_chunk.seed_dims
+                    chunk_x = np.zeros((n_live, n_var_ws), np.float64)
+                    chunk_z = np.zeros((n_live, m_con_ws), np.float64)
             # serve backend: the service request ids of this chunk's
             # points, so the quarantine path names the same id the
             # serve.request trace spans carry
@@ -314,8 +334,13 @@ def run_sweep(nlp, spec: SweepSpec, *,
                 for attempt in range(1, opts.max_retries + 1):
                     single = {k: np.asarray(v)[j:j + 1]
                               for k, v in values.items()}
-                    o1, c1, i1, r1 = solve_chunk(
-                        single, 1, point_ids=[int(idxs[j])])
+                    try:
+                        o1, c1, i1, r1 = solve_chunk(
+                            single, 1, point_ids=[int(idxs[j])])
+                    except PlanError:
+                        # the lone lane is the guilty lane: grade the
+                        # attempt failed and keep retrying/quarantine
+                        o1, c1, i1, r1 = _failed_chunk(1)
                     retry_rids = getattr(solve_chunk, "last_request_ids",
                                          None)
                     if retry_rids:
@@ -557,7 +582,10 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
             vmap_axes=((in_axes, 0) if warm_seed else (in_axes,)),
             donate_argnums=())
 
-        def solve_chunk(values, n_live, point_ids=None, seeds=None):
+        def _stage_chunk(values, n_live, seeds):
+            """Stage one (sub-)chunk from host rows; the restage path
+            reuses this so plan retry/bisection re-stages from the
+            caller-owned numpy rows (staged buffers may be gone)."""
             width = xplan.lanes_for(n_live, opts.chunk_size)
             padded = _pad_rows(values, width)
             p = dict(defaults["p"])
@@ -581,12 +609,28 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
                     {"x0": seeds[0], "z0": seeds[1], "kind": seeds[2]},
                     width)[k] for k in ("x0", "z0", "kind"))
                 start = xplan.stage(start, lanes=width, donate=False)
-                args = (staged, start)
-            else:
-                args = (staged,)
+                return (staged, start), width
+            return (staged,), width
+
+        def solve_chunk(values, n_live, point_ids=None, seeds=None):
+            args, width = _stage_chunk(values, n_live, seeds)
+
+            def _restage(idxs):
+                rows = list(idxs)
+                sub = {k: np.asarray(v)[rows] for k, v in values.items()}
+                sub_seeds = (None if seeds is None else
+                             tuple(np.asarray(s)[rows] for s in seeds))
+                sub_args, sub_width = _stage_chunk(sub, len(rows),
+                                                   sub_seeds)
+                ids = ([point_ids[i] for i in rows]
+                       if point_ids is not None else None)
+                return sub_args, sub_width, ids
+
             ticket = xplan.submit(
                 program, args, n_live=n_live, lanes=width,
-                request_ids=(point_ids if obs_trace.enabled() else None))
+                request_ids=(point_ids if (obs_trace.enabled()
+                                           or _faults.armed()) else None),
+                restage=_restage)
             # collect() fences before _extract so the chunk timer
             # upstream measures device completion, not async dispatch
             # (points/s honesty)
@@ -599,6 +643,8 @@ def _make_backend(nlp, opts: SweepOptions, defaults, names_p, names_f, *,
 
         solve_chunk._graft_counter = program._graft_counter
         solve_chunk.supports_seeds = warm_seed
+        if warm_seed:
+            solve_chunk.seed_dims = (n_var, m_con)
         return solve_chunk
 
     if backend == "mesh":

@@ -56,6 +56,11 @@ def test_preview_record_passes_schema(bench):
         assert key in out["warmstart"]
     for key in bench.WARMSTART_NONNULL_KEYS:
         assert out["warmstart"][key] is not None
+    # the chaos A/B (r12): recovery headline measured, never null
+    for key in bench.CHAOS_KEYS:
+        assert key in out["chaos"]
+    for key in bench.CHAOS_NONNULL_KEYS:
+        assert out["chaos"][key] is not None
 
 
 def test_preview_soak_section(bench):
@@ -294,6 +299,43 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["warmstart"]
     bench.validate_bench_output(out)
+    # chaos (r12): optional-but-complete, recovery headline non-null
+    out = json.load(open(PREVIEW))
+    del out["chaos"]["fault_recovery_rate"]
+    with pytest.raises(ValueError, match="fault_recovery_rate"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["chaos"]["soak_p99_ms"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["chaos"]
+    bench.validate_bench_output(out)
+
+
+def test_preview_chaos_section(bench):
+    """The r12 chaos section backs the robustness acceptance: under
+    the canonical fault scenario (transient fence faults + a poison
+    rule over a mid-replay window) every injected fault was contained,
+    no handle hung, guilty lanes surfaced as ERROR, and the chaos-arm
+    p99 stayed within 2x of the clean baseline replay."""
+    out = json.load(open(PREVIEW))
+    chaos = out["chaos"]
+    assert chaos["n_requests"] > 0
+    assert chaos["hung"] == 0
+    assert chaos["injected"] == chaos["recovered"] > 0
+    assert chaos["fault_recovery_rate"] == 1.0
+    assert chaos["errors"] > 0  # the poison rule found riders
+    # every request terminal: done/error/shed (+ timeouts) cover all
+    assert (chaos["requests_done"] + chaos["errors"] + chaos["shed"]
+            <= chaos["n_requests"])
+    assert chaos["plan_retries"] > 0
+    assert 0.0 < chaos["soak_p99_ms"]
+    assert 0.0 < chaos["baseline_p99_ms"]
+    # bench rounds the ratio to 4 decimals when recording it
+    assert chaos["p99_ratio_chaos_vs_baseline"] == pytest.approx(
+        chaos["soak_p99_ms"] / chaos["baseline_p99_ms"], abs=5e-5)
+    assert chaos["p99_ratio_chaos_vs_baseline"] < 2.0
 
 
 def test_bench_record_round_trips_through_ledger(bench, tmp_path):

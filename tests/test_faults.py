@@ -1,0 +1,487 @@
+"""Fault injection + failure domains (docs/robustness.md).
+
+Coverage, all on stub kernels and fake clocks (tier-1 cheap):
+
+* ``faults.inject`` — scenario grammar, per-rule determinism, fire
+  budgets / gating fields, env + programmatic arming;
+* the **plan failure domain** on a toy program — transient faults cost
+  a full-batch retry and nobody sees an error, persistent poison rules
+  are isolated by lane bisection (innocents bitwise-correct, guilty
+  NaN-filled), no ``restage`` means ``collect()`` raises ``PlanError``,
+  and the retry backoff is exponential and capped;
+* the **serve failure domain** — the no-hang contract (every handle
+  terminal), guilty-lane isolation with innocent batchmates DONE,
+  both load-shedding triggers, clock-skew-driven timeouts, and the
+  degradation-ladder rungs;
+* the disarmed hot path is **spy-pinned**: with no scenario armed the
+  serve/plan fast paths never reach ``faults.check`` at all;
+* a threaded concurrent-submit-during-dispatch stress: every handle
+  completes exactly once.
+
+Counters (``faults.injected`` / ``faults.recovered`` /
+``plan.retries``) are process-cumulative registry counters, so every
+assertion here is a before/after delta.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.faults import inject as faults
+from dispatches_tpu.obs import registry as reg
+from dispatches_tpu.obs.soak import FakeClock, StubNLP, make_stub_solver
+from dispatches_tpu.plan import ExecutionPlan, PlanError, PlanOptions
+from dispatches_tpu.plan import execution as plan_execution
+from dispatches_tpu.serve import RequestStatus, ServeOptions, SolveService
+from dispatches_tpu.serve import service as serve_service
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed, with the env cache cleared
+    (arming is process-global)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _retries_total() -> float:
+    return reg.counter("plan.retries").total()
+
+
+# ---------------------------------------------------------------------------
+# scenario grammar + rule semantics
+# ---------------------------------------------------------------------------
+
+
+def test_parse_scenario_string_grammar():
+    sc = faults.parse_scenario(
+        "plan.fence,p=0.25,times=6,seed=7;plan.fence,poison_mod=37")
+    assert len(sc.rules) == 2
+    r0, r1 = sc.rules
+    assert r0.site == "plan.fence" and r0.p == 0.25
+    assert r0.times == 6 and r0.seed == 7
+    assert r1.poison_mod == 37
+    # poison rules default to a persistent fault: retries must keep
+    # failing until bisection isolates the lane
+    assert r1.times is None
+
+
+def test_parse_scenario_dict_and_list_shapes():
+    assert faults.parse_scenario(None) is None
+    assert faults.parse_scenario("") is None
+    sc = faults.parse_scenario({"rules": [
+        {"site": "solver", "match": "sweep"},
+        "serve.stage,times=2",
+    ]})
+    assert [r.site for r in sc.rules] == ["solver", "serve.stage"]
+    assert sc.rules[0].match == "sweep"
+    assert sc.rules[1].times == 2
+    # times=0 / -1 / null all mean unlimited
+    for spec in ("plan.stage,times=0", "plan.stage,times=-1",
+                 {"site": "plan.stage", "times": None}):
+        assert faults.parse_scenario(spec).rules[0].times is None
+
+
+def test_parse_rejects_unknown_site_and_field():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_scenario("plan.bogus,times=1")
+    with pytest.raises(ValueError, match="unknown fault rule field"):
+        faults.parse_scenario("plan.fence,frequency=2")
+    with pytest.raises(ValueError, match="missing site"):
+        faults.parse_scenario("p=0.5")
+
+
+def _fire_seq(sc, n=200, site="plan.fence"):
+    out = []
+    for _ in range(n):
+        try:
+            sc.check(site, label="x")
+            out.append(0)
+        except faults.InjectedFault:
+            out.append(1)
+    return out
+
+
+def test_probabilistic_rule_is_deterministic_per_seed():
+    spec = "plan.fence,p=0.3,times=0,seed=11"
+    a = _fire_seq(faults.parse_scenario(spec))
+    b = _fire_seq(faults.parse_scenario(spec))
+    assert a == b
+    assert 0 < sum(a) < len(a)  # actually probabilistic
+    c = _fire_seq(faults.parse_scenario("plan.fence,p=0.3,times=0,seed=12"))
+    assert a != c
+
+
+def test_times_after_every_and_match_gate_fires():
+    sc = faults.parse_scenario("plan.fence,times=2,after=1,every=2")
+    # eligible calls: skip 1, then fire on every 2nd, budget 2
+    assert _fire_seq(sc, 8) == [0, 1, 0, 1, 0, 0, 0, 0]
+    sc = faults.parse_scenario("plan.fence,match=sweep,times=0")
+    sc.check("plan.fence", label="serve.pdlp#0")  # no match: silent
+    with pytest.raises(faults.InjectedFault):
+        sc.check("plan.fence", label="sweep.chunk")
+    # wrong site never fires regardless of budget
+    sc.check("plan.submit", label="sweep.chunk")
+
+
+def test_poison_rules_need_a_riding_request_id():
+    sc = faults.parse_scenario("plan.fence,poison_ids=3|7")
+    sc.check("plan.fence", request_ids=None)       # no ids: silent
+    sc.check("plan.fence", request_ids=[1, 2, 4])  # innocent batch
+    with pytest.raises(faults.InjectedFault):
+        sc.check("plan.fence", request_ids=[2, 7])
+    sc = faults.parse_scenario("plan.fence,poison_mod=5")
+    sc.check("plan.fence", request_ids=[3, 4, 6])
+    with pytest.raises(faults.InjectedFault):
+        sc.check("plan.fence", request_ids=[3, 10])
+
+
+def test_arming_env_programmatic_and_restore(monkeypatch):
+    assert not faults.armed()
+    monkeypatch.setenv("DISPATCHES_TPU_FAULTS", "plan.fence,times=1")
+    assert not faults.armed()  # env was cached at first check
+    faults.reset()
+    assert faults.armed()      # reset forgets the cache
+    prev = faults.arm("serve.stage,times=1")
+    assert prev is not None and prev.rules[0].site == "plan.fence"
+    restored = faults.arm(prev)
+    assert restored.rules[0].site == "serve.stage"
+    assert faults.disarm() is prev
+    assert not faults.armed()
+
+
+def test_clock_skew_counts_but_never_raises():
+    faults.arm("service.clock,skew_s=2.5,times=2")
+    sk0 = reg.counter("faults.skewed").total()
+    inj0 = faults.injected_total()
+    assert faults.clock_skew() == 2.5
+    assert faults.clock_skew() == 2.5
+    assert faults.clock_skew() == 0.0  # budget spent
+    assert reg.counter("faults.skewed").total() == sk0 + 2
+    # skews are not "injected" faults: they must not distort recovery
+    assert faults.injected_total() == inj0
+
+
+# ---------------------------------------------------------------------------
+# plan failure domain on a toy program
+# ---------------------------------------------------------------------------
+
+
+def _toy_plan(**opts):
+    opts.setdefault("inflight", 2)
+    opts.setdefault("donate", False)
+    plan = ExecutionPlan(PlanOptions(**opts))
+    prog = plan.program(lambda a: a * 2.0, label="faults.toy", vmap_axes=0)
+    return plan, prog
+
+
+def _submit_toy(plan, prog, vals, request_ids=None, restage=True):
+    arr = np.asarray(vals, np.float64)
+
+    def _restage(idxs):
+        rows = arr[list(idxs)]
+        staged = plan.stage(jnp.asarray(rows), lanes=rows.shape[0],
+                            donate=False)
+        ids = (None if request_ids is None
+               else [request_ids[i] for i in idxs])
+        return (staged,), rows.shape[0], ids
+
+    staged = plan.stage(jnp.asarray(arr), lanes=arr.shape[0], donate=False)
+    return plan.submit(prog, (staged,), n_live=arr.shape[0],
+                       lanes=arr.shape[0], request_ids=request_ids,
+                       restage=_restage if restage else None)
+
+
+def test_plan_transient_fault_retries_to_success():
+    plan, prog = _toy_plan()
+    faults.arm("plan.fence,times=1")
+    inj0, rec0, ret0 = (faults.injected_total(), faults.recovered_total(),
+                        _retries_total())
+    ticket = _submit_toy(plan, prog, [1.0, 2.0, 3.0, 4.0])
+    res = plan.collect(ticket)
+    np.testing.assert_allclose(np.asarray(res), [2.0, 4.0, 6.0, 8.0])
+    # one retry, no guilty lanes, fault contained
+    assert ticket.error is not None and ticket.error.guilty == ()
+    assert ticket.error.attempts == 1
+    assert faults.injected_total() - inj0 == 1
+    assert faults.recovered_total() - rec0 == 1
+    assert _retries_total() - ret0 == 1
+
+
+def test_plan_poison_bisection_isolates_guilty_lane():
+    plan, prog = _toy_plan()
+    ids = [11, 12, 13, 14]
+    faults.arm("plan.fence,poison_ids=13")
+    ret0 = _retries_total()
+    ticket = _submit_toy(plan, prog, [1.0, 2.0, 3.0, 4.0],
+                         request_ids=ids)
+    res = np.asarray(plan.collect(ticket))
+    # guilty lane NaN-filled, innocents bitwise-correct
+    assert ticket.error.guilty == (2,)
+    assert np.isnan(res[2])
+    np.testing.assert_allclose(res[[0, 1, 3]], [2.0, 4.0, 8.0])
+    # full retries + O(log n) bisection redispatches all count
+    assert _retries_total() - ret0 > 1
+
+
+def test_plan_all_guilty_collect_raises():
+    plan, prog = _toy_plan()
+    faults.arm("plan.fence,poison_mod=1")  # every riding id is guilty
+    ticket = _submit_toy(plan, prog, [1.0, 2.0], request_ids=[1, 2])
+    with pytest.raises(PlanError) as ei:
+        plan.collect(ticket)
+    assert ei.value.guilty == (0, 1)
+    assert ticket.result is None
+
+
+def test_plan_without_restage_fails_whole_batch():
+    plan, prog = _toy_plan()
+    faults.arm("plan.fence,times=1")
+    ticket = _submit_toy(plan, prog, [1.0, 2.0, 3.0], restage=False)
+    with pytest.raises(PlanError) as ei:
+        plan.collect(ticket)
+    assert ei.value.guilty == (0, 1, 2)
+    assert ei.value.attempts == 0  # nothing to retry with
+
+
+def test_plan_retry_backoff_is_exponential_and_capped(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(plan_execution.time, "sleep", sleeps.append)
+    plan, prog = _toy_plan(max_retries=5, retry_backoff_ms=100.0)
+    faults.arm("plan.fence,times=4")  # submit-fence + 3 failed retries
+    ticket = _submit_toy(plan, prog, [1.0, 2.0])
+    res = plan.collect(ticket)
+    np.testing.assert_allclose(np.asarray(res), [2.0, 4.0])
+    assert ticket.error.attempts == 4
+    # 100ms doubling per attempt, capped at 250ms
+    assert sleeps == [0.1, 0.2, 0.25, 0.25]
+
+
+# ---------------------------------------------------------------------------
+# serve failure domain (stub kernels, fake clock)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stub_nlp():
+    return StubNLP()
+
+
+@pytest.fixture(scope="module")
+def stub_solver():
+    return make_stub_solver()
+
+
+def _new_service(clock=None, **opt):
+    plan = ExecutionPlan(PlanOptions(inflight=2))
+    kw = {} if clock is None else {"clock": clock}
+    return SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                     warm_start=False, plan=plan, **opt),
+                        **kw)
+
+
+def _run_batch(svc, nlp, stub, n=4, deadline_ms=None):
+    hs = [svc.submit(nlp, nlp.default_params(), solver="pdlp",
+                     base_solver=stub, deadline_ms=deadline_ms)
+          for _ in range(n)]
+    svc.flush_all()
+    return hs
+
+
+def test_serve_stage_fault_fails_batch_no_hang(stub_nlp, stub_solver):
+    faults.arm("serve.stage,times=1")
+    inj0, rec0 = faults.injected_total(), faults.recovered_total()
+    svc = _new_service()
+    hs = _run_batch(svc, stub_nlp, stub_solver)
+    # every handle reaches a terminal status — nobody hangs
+    assert [h.result().status for h in hs] == [RequestStatus.ERROR] * 4
+    assert faults.injected_total() - inj0 == 1
+    assert faults.recovered_total() - rec0 == 1
+    assert svc.metrics()["errors"] == 4
+
+
+def test_serve_transient_fence_fault_is_invisible(stub_nlp, stub_solver):
+    faults.arm("plan.fence,times=1")
+    inj0, rec0 = faults.injected_total(), faults.recovered_total()
+    svc = _new_service()
+    hs = _run_batch(svc, stub_nlp, stub_solver)
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+    assert faults.injected_total() - inj0 == 1
+    assert faults.recovered_total() - rec0 == 1
+    assert svc.metrics()["errors"] == 0
+
+
+def test_serve_poisoned_lane_innocent_batchmates_solve(stub_nlp,
+                                                       stub_solver):
+    svc = _new_service()
+    pid = 3  # third request of this fresh service (ids count from 1)
+    faults.arm(f"plan.fence,poison_ids={pid}")
+    hs = _run_batch(svc, stub_nlp, stub_solver)
+    res = {h.request_id: h.result().status for h in hs}
+    assert res[pid] == RequestStatus.ERROR
+    assert all(s == RequestStatus.DONE
+               for rid, s in res.items() if rid != pid)
+    m = svc.metrics()
+    assert m["errors"] == 1 and m["solved"] == 3
+
+
+def test_serve_shed_queue_depth(stub_nlp, stub_solver):
+    shed0 = reg.counter("serve.shed").total()
+    svc = _new_service(shed_queue_depth=2)
+    hs = [svc.submit(stub_nlp, stub_nlp.default_params(), solver="pdlp",
+                     base_solver=stub_solver) for _ in range(4)]
+    svc.flush_all()
+    sts = [h.result().status for h in hs]
+    assert sts.count(RequestStatus.SHED) >= 1
+    assert set(sts) <= {RequestStatus.DONE, RequestStatus.SHED}
+    n_shed = sts.count(RequestStatus.SHED)
+    assert reg.counter("serve.shed").total() - shed0 == n_shed
+    assert svc.metrics()["shed"] == n_shed
+
+
+def test_serve_shed_signal(stub_nlp, stub_solver):
+    svc = _new_service()
+    svc.shed_signal = lambda: True
+    h = svc.submit(stub_nlp, stub_nlp.default_params(), solver="pdlp",
+                   base_solver=stub_solver)
+    assert h.result().status == RequestStatus.SHED
+    # signal cleared: traffic flows again
+    svc.shed_signal = None
+    hs = _run_batch(svc, stub_nlp, stub_solver, n=2)
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+
+
+def test_serve_clock_skew_times_out_deadline(stub_nlp, stub_solver):
+    svc = _new_service(clock=FakeClock())
+    # after=1: the submit-time _now() computes an unskewed deadline,
+    # then dispatch triage reads a clock 10s in the future
+    faults.arm("service.clock,skew_s=10.0,times=0,after=1")
+    h = svc.submit(stub_nlp, stub_nlp.default_params(), solver="pdlp",
+                   base_solver=stub_solver, deadline_ms=1000.0)
+    svc.flush_all()
+    assert h.result(timeout=5.0).status == RequestStatus.TIMEOUT
+
+
+# ---------------------------------------------------------------------------
+# graceful-degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_degrade_warm_rung_demotes_to_cold_once(stub_nlp, stub_solver):
+    svc = _new_service()
+    _run_batch(svc, stub_nlp, stub_solver, n=1)
+    bucket = next(iter(svc._buckets.values()))
+    bucket.warm_consec_mispredicts = 4
+    d0 = reg.counter("serve.degrade").total()
+    svc._degrade_warm(bucket)
+    assert bucket.warm_fallback is True
+    svc._degrade_warm(bucket)  # idempotent: the rung engages once
+    assert reg.counter("serve.degrade").total() - d0 == 1
+
+
+def test_degrade_precision_rung_redirects_new_submissions(stub_nlp,
+                                                          stub_solver):
+    svc = _new_service()
+    _run_batch(svc, stub_nlp, stub_solver, n=1)
+    bucket = next(iter(svc._buckets.values()))
+    d0 = reg.counter("serve.degrade").total()
+    svc._degrade_precision(bucket)
+    twin = bucket.redirect
+    assert twin is not None and twin.precision == "f32"
+    assert reg.counter("serve.degrade").total() - d0 == 1
+    svc._degrade_precision(bucket)  # second engage is a no-op
+    assert bucket.redirect is twin
+    # new submissions follow the redirect; the twin does the solving
+    hs = _run_batch(svc, stub_nlp, stub_solver, n=2)
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+    assert twin.stats.submitted == 2
+
+
+def test_degrade_precision_bails_when_env_pins_tier(stub_nlp, stub_solver,
+                                                    monkeypatch):
+    svc = _new_service()
+    _run_batch(svc, stub_nlp, stub_solver, n=1)
+    bucket = next(iter(svc._buckets.values()))
+    monkeypatch.setenv("DISPATCHES_TPU_PDLP_PRECISION", "bf16x-f32")
+    svc._degrade_precision(bucket)
+    assert bucket.redirect is None  # env wins: nothing to fall to
+
+
+# ---------------------------------------------------------------------------
+# disarmed hot path: spy-pinned zero overhead
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_hot_paths_never_reach_check(stub_nlp, stub_solver,
+                                              monkeypatch):
+    def tripwire(*a, **k):
+        raise AssertionError("faults.check reached while disarmed")
+
+    monkeypatch.setattr(faults, "check", tripwire)
+    monkeypatch.setattr(faults, "clock_skew", tripwire)
+    assert not faults.armed()
+    # serve path (submit -> stage -> plan dispatch -> fence -> complete)
+    svc = _new_service()
+    hs = _run_batch(svc, stub_nlp, stub_solver)
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+    # bare plan path, including a collect
+    plan, prog = _toy_plan()
+    ticket = _submit_toy(plan, prog, [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(plan.collect(ticket)),
+                               [2.0, 4.0])
+
+
+# ---------------------------------------------------------------------------
+# concurrency: every handle completes exactly once
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_submit_during_dispatch_completes_each_once(
+        stub_nlp, stub_solver, monkeypatch):
+    completions = {}
+    comp_lock = threading.Lock()
+    orig = serve_service.SolveHandle._complete
+
+    def counted(self, serve_result):
+        # keyed by the handle object (strong ref): id() could be
+        # reused after a completed handle is garbage-collected
+        with comp_lock:
+            completions[self] = completions.get(self, 0) + 1
+        return orig(self, serve_result)
+
+    monkeypatch.setattr(serve_service.SolveHandle, "_complete", counted)
+    svc = _new_service()
+    # prime the bucket (and its compile) before the threads race
+    _run_batch(svc, stub_nlp, stub_solver, n=1)
+    handles = []
+    h_lock = threading.Lock()
+    errors = []
+
+    def submitter(n):
+        try:
+            for _ in range(n):
+                h = svc.submit(stub_nlp, stub_nlp.default_params(),
+                               solver="pdlp", base_solver=stub_solver)
+                with h_lock:
+                    handles.append(h)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=submitter, args=(8,))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    # dispatch continuously while submissions stream in
+    for _ in range(64):
+        svc.flush_all()
+    for t in threads:
+        t.join()
+    svc.flush_all()
+    assert errors == []
+    assert len(handles) == 32
+    results = [h.result(timeout=30.0) for h in handles]
+    assert all(r.status == RequestStatus.DONE for r in results)
+    assert all(completions[h] == 1 for h in handles)

@@ -9,6 +9,7 @@ test_sweep.py and the slow-lane acceptance test.
 """
 
 import json
+import logging
 import os
 
 import numpy as np
@@ -317,14 +318,20 @@ def test_flight_directory_is_bounded(tmp_path, monkeypatch):
     assert all(b["kind"] == "quarantine" for b in found)
 
 
-def test_flight_trigger_never_raises(tmp_path, monkeypatch):
+def test_flight_trigger_never_raises(tmp_path, monkeypatch, caplog):
     flight.enable(str(tmp_path))
 
     def explode(*a, **k):
         raise RuntimeError("disk on fire")
 
     monkeypatch.setattr(flight, "_write_bundle", explode)
-    assert flight.trigger("nan_guard") is None  # swallowed, not raised
+    errs0 = reg.counter("flight.errors").total()
+    with caplog.at_level(logging.DEBUG, logger="dispatches_tpu.obs.flight"):
+        assert flight.trigger("nan_guard") is None  # swallowed, not raised
+    # the swallow is not silent: it counts and leaves a debug trail
+    assert reg.counter("flight.errors").total() == errs0 + 1
+    assert any("flight bundle write failed" in r.getMessage()
+               for r in caplog.records)
 
 
 def test_flight_cli_lists_and_dumps(tmp_path, capsys):

@@ -30,6 +30,7 @@ import json
 import numpy as np
 import pytest
 
+from dispatches_tpu.faults import inject as faults
 from dispatches_tpu.obs import export as obs_export
 from dispatches_tpu.obs import flight as obs_flight
 from dispatches_tpu.obs import online
@@ -584,6 +585,79 @@ def test_soak_deadlines_feed_miss_ratio():
     ratio = [o for o in report["slo"]["objectives"]
              if o["objective"] == "soak_deadline_miss_ratio"]
     assert ratio and ratio[0]["burn_peak"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# chaos soaks (faults section; docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+
+def test_soak_faults_section_merges_over_defaults():
+    spec = obs_soak.load_soak_spec(
+        overrides={"faults": {"scenario": "plan.fence,times=1",
+                              "start_s": 0.5}})
+    fl = spec["faults"]
+    assert fl["scenario"] == "plan.fence,times=1"
+    assert fl["start_s"] == 0.5
+    # untouched fields keep their defaults (shallow per-section merge)
+    assert fl["stop_s"] is None
+    assert fl["shed_queue_depth"] is None
+    assert fl["shed_on_burn"] is False
+
+
+def test_soak_baseline_report_carries_clean_fault_block():
+    faults.reset()
+    report = obs_soak.run_soak(
+        {"traffic": {"duration_s": 1.0, "rate_rps": 120.0}})
+    c = report["requests"]
+    assert c["done"] == c["submitted"] > 0
+    assert c["hung"] == c["error"] == c["shed"] == 0
+    assert report["fault_recovery_rate"] == 1.0
+    fl = report["faults"]
+    assert fl["armed"] is False and fl["injected"] == 0
+
+
+def test_soak_chaos_window_recovers_everything_no_hangs():
+    """The chaos acceptance replay (same scenario as the CI smoke and
+    the bench chaos arm): transient fence faults plus a poison rule
+    armed over a mid-replay window.  Every injected fault is contained
+    (rate exactly 1.0), every handle is terminal (zero hung), poisoned
+    lanes surface as ERROR with their batchmates solving, and the
+    scenario is disarmed/restored after the window."""
+    faults.reset()
+    report = obs_soak.run_soak({
+        "traffic": {"duration_s": 2.0, "rate_rps": 150.0},
+        "faults": {
+            "scenario": ("plan.fence,p=0.25,times=6,seed=7;"
+                         "plan.fence,poison_mod=37"),
+            "start_s": 0.25, "stop_s": 1.75},
+    })
+    c = report["requests"]
+    fl = report["faults"]
+    assert c["hung"] == 0
+    assert (c["done"] + c["timeout"] + c["error"] + c["shed"]
+            == c["submitted"])
+    assert fl["armed"] is True and fl["injected"] > 0
+    assert fl["recovered"] == fl["injected"]
+    assert fl["plan_retries"] > 0
+    assert report["fault_recovery_rate"] == 1.0
+    assert c["error"] > 0  # poison_mod guilty lanes surfaced as ERROR
+    assert not faults.armed()  # restored after the window
+    # the chaos line rides the text report
+    assert "faults:" in obs_soak.format_soak_report(report)
+
+
+def test_soak_shed_queue_depth_sheds_without_hanging():
+    faults.reset()
+    report = obs_soak.run_soak({
+        "traffic": {"duration_s": 1.0, "rate_rps": 400.0},
+        "service": {"max_batch": 8, "max_wait_ms": 50.0},
+        "faults": {"shed_queue_depth": 3},
+    })
+    c = report["requests"]
+    assert c["shed"] > 0 and c["hung"] == 0
+    assert report["faults"]["shed"] == c["shed"]
+    assert report["fault_recovery_rate"] == 1.0  # nothing injected
 
 
 def test_soak_cli_json_contract(tmp_path, capsys, monkeypatch):

@@ -49,6 +49,25 @@ def test_exact_lookup_returns_newest_for_key():
     assert idx.exact("missing") is None
 
 
+def test_nonfinite_solutions_never_enter_the_index():
+    """Regression: a diverged lane (NaN objective / NaN iterates) must
+    never seed future starts — one poisoned entry would mispredict
+    every retrieval near it (docs/robustness.md, rung 1)."""
+    idx = WarmStartIndex(capacity=8)
+    good_x, good_z = np.ones(3), np.ones(2)
+    idx.add("nan-x", np.ones(4), np.array([1.0, np.nan, 1.0]), good_z)
+    idx.add("inf-z", np.ones(4), good_x, np.array([np.inf, 0.0]))
+    idx.add("nan-vec", np.array([1.0, np.nan, 1.0, 1.0]), good_x, good_z)
+    assert idx.exact("nan-x") is None
+    assert idx.exact("inf-z") is None
+    assert idx.exact("nan-vec") is None
+    assert idx.nearest(np.ones(4)) is None
+    # a finite insert into the same index still lands
+    idx.add("ok", np.ones(4), good_x, good_z)
+    assert idx.exact("ok") is not None
+    assert idx.nearest(np.ones(4)) is not None
+
+
 def test_radius_gate_falls_back_to_cold():
     idx = WarmStartIndex(capacity=8, radius=0.25)
     idx.add(0, np.ones(4), np.zeros(3), np.zeros(2))
