@@ -131,6 +131,24 @@ REGISTERED_FLAGS = {
     "refine-failed lanes per bf16x-f32 bucket before new submits "
     "redirect to an f32 twin bucket (serve.ServeOptions.from_env; "
     "default 3)",
+    "PLAN_SCHEDULE": "execution-plan fence order: 'fifo' (oldest "
+    "first, the default) or 'ready' (probe jax.Array.is_ready and "
+    "retire whichever dispatched batch completed first; FIFO fallback "
+    "when the probe is unavailable) (plan.PlanOptions.from_env)",
+    "PLAN_INFLIGHT_MAX": "arm the adaptive in-flight depth controller: "
+    "AIMD moves the dispatch window between 1 and this bound from live "
+    "stall attribution, starting at PLAN_INFLIGHT "
+    "(plan.PlanOptions.from_env; unset = fixed window)",
+    "SERVE_ADAPTIVE_WAIT": "solve-service adaptive batch forming: close "
+    "a bucket early when the marginal wait would push the oldest "
+    "request past its deadline (per-bucket service-time estimate from "
+    "cost cards + streaming p95), hold while coalescing another "
+    "arrival is free (serve.ServeOptions.from_env; unset = fixed "
+    "SERVE_MAX_WAIT_MS)",
+    "SERVE_HOLD_MAX_MS": "solve-service adaptive batch forming: hard "
+    "cap on how long a deadline-slack-rich bucket may hold beyond "
+    "SERVE_MAX_WAIT_MS waiting to coalesce arrivals "
+    "(serve.ServeOptions.from_env; default 4x SERVE_MAX_WAIT_MS)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

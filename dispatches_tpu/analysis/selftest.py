@@ -177,6 +177,10 @@ CORPUS: Dict[str, Dict[str, str]] = {
             shed = os.environ.get("DISPATCHES_TPU_SERVE_SHED_QUEUE_DEPTH")
             dg_mp = os.environ.get("DISPATCHES_TPU_SERVE_DEGRADE_MISPREDICTS")
             dg_rf = os.environ.get("DISPATCHES_TPU_SERVE_DEGRADE_REFINE_FAILS")
+            sched = os.environ.get("DISPATCHES_TPU_PLAN_SCHEDULE")
+            in_max = os.environ.get("DISPATCHES_TPU_PLAN_INFLIGHT_MAX")
+            adw = os.environ.get("DISPATCHES_TPU_SERVE_ADAPTIVE_WAIT")
+            hold = os.environ.get("DISPATCHES_TPU_SERVE_HOLD_MAX_MS")
         """,
     },
     "GL008": {

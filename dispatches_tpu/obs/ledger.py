@@ -92,10 +92,14 @@ GATED_METRICS = {
     # (obs.timeline): the fraction of host stage/dispatch wall time
     # hidden under in-flight device work.  Higher is better — a drop
     # means the pipeline stopped running ahead (the ISSUE-9 win
-    # silently reverting).  ``plan_stall_pct`` rides along ungated:
-    # its fence-bound component grows with device utilisation, so a
-    # one-sided gate would misfire.
+    # silently reverting).
     "overlap_efficiency": +1,
+    # ahead-arm stall share from the same timeline.  Gated lower-is-
+    # better since ISSUE-14: with out-of-order fencing + the adaptive
+    # window, fence-bound time is no longer a fixed tax of running
+    # ahead — the scheduler's whole job is to shrink it, so a rise
+    # means the adaptive machinery quietly stopped working.
+    "plan_stall_pct": -1,
     # bench soak section (obs.soak): streaming P² p99 over the
     # real-clock deadline-bearing replay after lane-program warmup,
     # and the worst multi-window SLO burn rate any objective reached —

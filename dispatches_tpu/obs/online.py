@@ -578,6 +578,14 @@ class TimelineAccumulator:
 
     # -- results -----------------------------------------------------------
 
+    def stalls(self) -> Dict[str, float]:
+        """Raw stall-attribution counters in microseconds (un-rounded,
+        monotone) — the adaptive in-flight depth controller diffs these
+        between decisions."""
+        return {"fence_bound_us": self._fence_bound_us,
+                "host_stage_bound_us": self._zero_host_us,
+                "queue_empty_us": self._zero_empty_us}
+
     def _figures(self) -> Dict:
         wall = max((self._t_hi or 0.0) - (self._t_lo or 0.0), 0.0)
         eff = (self._hidden_us / self._host_us) if self._host_us > 0 else 0.0

@@ -309,7 +309,11 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     # -- service + plan ----------------------------------------------------
     from dispatches_tpu.plan.execution import PlanOptions
 
-    plan_opts = PlanOptions(inflight=int(svc_cfg.get("inflight", 2)))
+    inflight_max = svc_cfg.get("inflight_max")
+    plan_opts = PlanOptions(
+        inflight=int(svc_cfg.get("inflight", 2)),
+        schedule=str(svc_cfg.get("schedule", "fifo")),
+        inflight_max=(None if inflight_max is None else int(inflight_max)))
     if virtual:
         model = ServiceTimeModel(
             base_ms=spec["service_time"]["base_ms"],
@@ -327,7 +331,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                      max_wait_ms=float(svc_cfg["max_wait_ms"]),
                      warm_start=False, plan=plan,
                      shed_queue_depth=(None if shed_depth is None
-                                       else int(shed_depth))),
+                                       else int(shed_depth)),
+                     adaptive_wait=bool(svc_cfg.get("adaptive_wait",
+                                                    False))),
         clock=clk)
 
     if nlp is None:

@@ -115,7 +115,7 @@ def build_timeline(events: List[Dict],
         elif e["name"] == "plan.submit":
             submits[args["seq"]] = {"t0": ts, "t1": ts + dur, "args": args}
         elif e["name"] == "plan.fence":
-            fences[args["seq"]] = {"t0": ts, "t1": ts + dur}
+            fences[args["seq"]] = {"t0": ts, "t1": ts + dur, "args": args}
     if not submits:
         return None
 
@@ -152,7 +152,20 @@ def build_timeline(events: List[Dict],
                               else round(fen["t1"] - fen["t0"], 1)),
             "span_us": round(fence_end - sub["t0"], 1),
             "inflight_after_submit": a.get("inflight"),
+            # retirement rank from the plan's fence counter: under
+            # schedule="ready" it can disagree with seq (out-of-order
+            # fence); None for unfenced batches / pre-PR-14 traces
+            "fence_order": (None if fen is None
+                            else (fen["args"] or {}).get("order")),
         })
+
+    # out-of-order fences: batches whose retirement rank disagrees
+    # with submission order (always 0 under FIFO scheduling)
+    ordered = [(b["fence_order"], b["seq"]) for b in batches
+               if b["fence_order"] is not None]
+    by_order = [seq for _, seq in sorted(ordered)]
+    fence_reorders = sum(1 for got, fifo in zip(by_order, sorted(by_order))
+                         if got != fifo)
 
     # -- overlap efficiency: host wall time hidden under in-flight work
     host_spans = stage_spans + [(s["t0"], s["t1"]) for s in submits.values()]
@@ -199,6 +212,7 @@ def build_timeline(events: List[Dict],
     return {
         "plan": plan,
         "n_batches": len(batches),
+        "fence_reorders": fence_reorders,
         "batches": batches,
         "wall_us": round(wall_us, 1),
         "host_us": round(host_us, 1),
@@ -267,7 +281,9 @@ def format_timeline(tl: Optional[Dict]) -> str:
     lines = [f"== plan {tl['plan']} pipeline timeline =="]
     lines.append(
         f"batches: {tl['n_batches']}  wall {tl['wall_us'] / 1e3:.3f} ms  "
-        f"host {tl['host_us'] / 1e3:.3f} ms")
+        f"host {tl['host_us'] / 1e3:.3f} ms"
+        + (f"  out-of-order fences: {tl['fence_reorders']}"
+           if tl.get("fence_reorders") else ""))
     lines.append(
         f"overlap efficiency: {tl['overlap_efficiency']:.3f} "
         f"({tl['hidden_host_us'] / 1e3:.3f} ms of host staging hidden "
@@ -286,11 +302,14 @@ def format_timeline(tl: Optional[Dict]) -> str:
     for b in tl["batches"]:
         rids = b.get("request_ids")
         wait = b.get("fence_wait_us")
+        order = b.get("fence_order")
         lines.append(
             f"  #{b['seq']:<3d} {b.get('label') or '?':<24s} "
             f"lanes {b.get('lanes')} live {b.get('live')}  "
             f"span {b['span_us'] / 1e3:8.3f} ms  "
             + (f"fence {wait / 1e3:8.3f} ms" if wait is not None
                else "in flight")
+            + (f"  fenced #{order}" if order is not None
+               and order != b["seq"] else "")
             + (f"  requests {rids}" if rids else ""))
     return "\n".join(lines) + "\n"

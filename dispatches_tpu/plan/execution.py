@@ -29,12 +29,31 @@ One plan = one placement policy plus one dispatch pipeline:
   ``scenario_sharded_solver`` contract) build their programs with
   ``donate=False``.
 * **dispatch-ahead** — ``submit()`` returns immediately (JAX async
-  dispatch); completed results are fenced in FIFO order, and the number
-  of dispatched-but-unfenced batches is bounded by
-  ``PlanOptions.inflight`` (default 2: batch *k+1* stages and
+  dispatch); the number of dispatched-but-unfenced batches is bounded
+  by ``PlanOptions.inflight`` (default 2: batch *k+1* stages and
   dispatches while batch *k* computes).  ``collect()``/``drain()``
   fence.  The ``plan.inflight`` gauge and retroactive ``plan.dispatch``
   spans expose the pipeline to ``dispatches_tpu.obs``.
+* **scheduling** — ``PlanOptions.schedule`` picks the fence order:
+  ``"fifo"`` (default, oldest first) or ``"ready"``, which probes
+  ticket readiness (``jax.Array.is_ready()``) and fences whichever
+  dispatched batch completed first, falling back to FIFO when nothing
+  is ready or the probe is unavailable.  Per-ticket results, recovery
+  semantics, and the fence-time ``on_done`` contract (run exactly once,
+  serialized, after the ticket completes) are identical in both modes —
+  only the order tickets retire changes, annotated on each
+  ``plan.fence`` span as ``order``.  ``PlanOptions.inflight_max`` arms
+  the AIMD depth controller (:mod:`dispatches_tpu.plan.adaptive`),
+  which grows/shrinks the window between ``inflight`` and the bound
+  from live stall attribution under a cost-card memory budget.
+
+The fence path holds the window lock only to pop the chosen ticket:
+the device wait, recovery, and ``on_done`` callbacks run outside it
+(a fence serializes other *fencers*, never submitters, and an
+``on_done`` that re-submits into the same plan cannot deadlock).
+Concurrent collectors of a ticket another thread is mid-fencing park
+on the ticket's completion event, so a ticket observed popped is still
+always observed completed (the no-hang contract).
 
 When tracing is enabled the plan also emits the batch **lifecycle
 timeline** — retroactive ``plan.stage`` / ``plan.submit`` /
@@ -118,6 +137,25 @@ class PlanOptions:
     #: base backoff between batch retries in milliseconds, doubled per
     #: attempt and capped at :data:`_BACKOFF_CAP_MS`.
     retry_backoff_ms: float = 5.0
+    #: fence order: ``"fifo"`` retires the oldest dispatched batch
+    #: first; ``"ready"`` probes ticket readiness and retires whichever
+    #: batch completed first (FIFO fallback when nothing is ready or
+    #: the probe is unavailable).
+    schedule: str = "fifo"
+    #: arms the adaptive in-flight depth controller: the window starts
+    #: at ``inflight`` and AIMD moves it within [1, inflight_max] from
+    #: live stall attribution.  None = fixed depth (the default).
+    inflight_max: Optional[int] = None
+    #: cost-card memory budget for the depth controller: growth stops
+    #: when ``peak_bytes × depth`` would exceed it (None = no budget;
+    #: needs ``obs.profile`` enabled to bind).
+    mem_budget_bytes: Optional[int] = None
+
+    def __post_init__(self):
+        if self.schedule not in ("fifo", "ready"):
+            raise ValueError(
+                f"PlanOptions.schedule must be 'fifo' or 'ready', "
+                f"got {self.schedule!r}")
 
     @classmethod
     def from_env(cls, **overrides) -> "PlanOptions":
@@ -136,6 +174,12 @@ class PlanOptions:
         raw = os.environ.get(flag_name("PLAN_RETRY_BACKOFF_MS"), "")
         if raw:
             env["retry_backoff_ms"] = float(raw)
+        raw = os.environ.get(flag_name("PLAN_SCHEDULE"), "")
+        if raw:
+            env["schedule"] = raw.strip().lower()
+        raw = os.environ.get(flag_name("PLAN_INFLIGHT_MAX"), "")
+        if raw:
+            env["inflight_max"] = int(raw)
         env.update(overrides)
         return cls(**env)
 
@@ -215,7 +259,8 @@ class PlanTicket:
 
     __slots__ = ("label", "lanes", "n_live", "seq", "request_ids",
                  "result", "error", "_raw", "_exc", "_restage",
-                 "_program", "_done", "_on_done", "_t_dispatch_us")
+                 "_program", "_done", "_on_done", "_t_dispatch_us",
+                 "_fencing", "_event")
 
     def __init__(self, label: str, lanes: int, n_live: int, on_done,
                  seq: int = 0, request_ids: Optional[List[int]] = None):
@@ -233,6 +278,10 @@ class PlanTicket:
         self._done = False
         self._on_done = on_done
         self._t_dispatch_us = 0.0
+        # popped off the window by a fencer but not yet completed; a
+        # concurrent collector parks on _event instead of re-fencing
+        self._fencing = False
+        self._event = threading.Event()
 
     def done(self) -> bool:
         return self._done
@@ -248,6 +297,33 @@ def _stack_leaves(leaves: Sequence) -> Any:
     if any(isinstance(leaf, jax.Array) for leaf in leaves):
         return jnp.stack([jnp.asarray(leaf) for leaf in leaves])
     return np.stack([np.asarray(leaf) for leaf in leaves])
+
+
+def _ticket_ready(ticket: PlanTicket) -> Optional[bool]:
+    """Non-blocking readiness probe for one dispatched ticket.
+
+    True when every device leaf reports ``is_ready()`` (a dispatch-time
+    host exception also counts: there is nothing left to wait on),
+    False when at least one leaf is still computing, None when the
+    probe is unavailable (non-``jax.Array`` leaves, or a backend whose
+    arrays lack ``is_ready``) — the scheduler treats None as "fall back
+    to FIFO"."""
+    if ticket._exc is not None:
+        return True
+    try:
+        leaves = jax.tree_util.tree_leaves(ticket._raw)
+    except Exception:  # noqa: BLE001 — probe must never raise
+        return None
+    for leaf in leaves:
+        probe = getattr(leaf, "is_ready", None)
+        if probe is None:
+            return None
+        try:
+            if not probe():
+                return False
+        except Exception:  # noqa: BLE001
+            return None
+    return True
 
 
 def _nan_like_lane(lane) -> Any:
@@ -297,12 +373,30 @@ class ExecutionPlan:
         self.mesh = mesh
         self.plan_id = next(_plan_ids)
         self._seq = itertools.count(1)
+        self._fence_seq = itertools.count(1)
         self._window: Deque[PlanTicket] = deque()
         # dispatch/fence window guard: serve's concurrent submitters
         # reach plan.submit/collect from multiple threads, and the
-        # FIFO window + exactly-once fence bookkeeping must not race.
-        # Host-side staging (the expensive part) stays outside it.
+        # window + exactly-once fence bookkeeping must not race.  The
+        # expensive parts — host staging, the device wait, recovery,
+        # on_done — all stay outside it.
         self._lock = threading.RLock()
+        # fence order guard: one fence (pop + wait + recovery +
+        # on_done) retires at a time, so fence-order annotations and
+        # on_done callbacks are serialized.  Reentrant: an on_done that
+        # re-submits may have to fence the window overflow itself.
+        self._fence_lock = threading.RLock()
+        self._ctrl = None
+        if self.options.inflight_max is not None:
+            from dispatches_tpu.plan.adaptive import InflightDepthController
+
+            self._ctrl = InflightDepthController(
+                base=max(int(self.options.inflight), 1),
+                max_inflight=int(self.options.inflight_max),
+                plan=self.plan_id,
+                mem_budget_bytes=self.options.mem_budget_bytes,
+                peak_bytes_fn=self._peak_bytes)
+        self._labels: set = set()
         self._gauge = obs_registry.gauge(
             "plan.inflight",
             "execution-plan batches dispatched but not yet fenced")
@@ -321,6 +415,34 @@ class ExecutionPlan:
     def inflight(self) -> int:
         """Batches currently dispatched but not yet fenced."""
         return len(self._window)
+
+    @property
+    def controller(self):
+        """The adaptive depth controller, or None (fixed window)."""
+        return self._ctrl
+
+    @property
+    def inflight_limit(self) -> int:
+        """The current dispatch-window bound (adaptive when the depth
+        controller is armed, ``options.inflight`` otherwise)."""
+        return self._window_limit()
+
+    def _window_limit(self) -> int:
+        if self._ctrl is not None:
+            return max(int(self._ctrl.depth), 1)
+        return max(int(self.options.inflight), 1)
+
+    def _peak_bytes(self) -> Optional[float]:
+        """Largest cost-card peak_bytes across programs this plan has
+        dispatched — the depth controller's per-slot memory model.
+        None when profiling is off or no card matches."""
+        from dispatches_tpu.obs import profile
+
+        if not profile.enabled():
+            return None
+        peaks = [c.get("peak_bytes") or 0 for label in tuple(self._labels)
+                 for c in profile.cards_for(label)]
+        return float(max(peaks)) if peaks else None
 
     def _axis_name(self) -> str:
         names = self.mesh.axis_names
@@ -366,7 +488,13 @@ class ExecutionPlan:
             trees.extend([trees[-1]] * (lanes - len(trees)))
         return jax.tree_util.tree_map(lambda *ls: _stack_leaves(ls), *trees)
 
-    def stage(self, tree, *, lanes: int, donate: bool = True, batched=True):
+    def _slot_device(self, slot: int):
+        """Round-robin mesh device for a ``stage(slot=...)`` batch."""
+        devs = list(self.mesh.devices.flat)
+        return devs[int(slot) % len(devs)]
+
+    def stage(self, tree, *, lanes: int, donate: bool = True, batched=True,
+              slot: Optional[int] = None):
         """Place one batched pytree for dispatch.
 
         ``batched`` is True (every leaf carries the lane axis), False
@@ -375,13 +503,23 @@ class ExecutionPlan:
         (the default) every staged leaf is guaranteed plan-owned: a
         leaf that is already a caller-owned ``jax.Array`` is copied, so
         a donating program can never delete a buffer the caller still
-        holds."""
+        holds.
+
+        ``slot`` (with a mesh) pins the whole batch on ONE mesh device,
+        round-robin by slot index, instead of sharding lanes across the
+        mesh: successive batches land on independent execution streams,
+        so their completions can genuinely invert — the placement shape
+        ``schedule="ready"`` out-of-order fencing exists to exploit."""
         tracing = obs_trace.enabled()
-        t0_us = obs_trace.now_us() if tracing else 0.0
+        stamp = tracing or self._ctrl is not None
+        t0_us = obs_trace.now_us() if stamp else 0.0
         if _faults.armed():
             _faults.check("plan.stage")
-        shard = self.sharding_for(lanes)
-        repl = self.replicated_sharding()
+        if slot is not None and self.mesh is not None:
+            shard = repl = self._slot_device(slot)
+        else:
+            shard = self.sharding_for(lanes)
+            repl = self.replicated_sharding()
 
         def place(leaf, is_batched=True):
             arr = jnp.asarray(leaf)
@@ -401,13 +539,19 @@ class ExecutionPlan:
             # vmap axes, because None is not a pytree leaf)
             staged = jax.tree_util.tree_map(
                 lambda leaf, b: place(leaf, bool(b)), tree, batched)
-        if tracing:
+        if stamp:
             # host staging is the wall time dispatch-ahead exists to
             # hide; the timeline scores how much of it overlapped an
             # in-flight batch of this plan
-            obs_trace.complete("plan.stage", t0_us,
-                               obs_trace.now_us() - t0_us,
-                               plan=self.plan_id, lanes=lanes)
+            end_us = obs_trace.now_us()
+            if tracing:
+                obs_trace.complete("plan.stage", t0_us, end_us - t0_us,
+                                   plan=self.plan_id, lanes=lanes)
+            if self._ctrl is not None:
+                self._ctrl.ingest({
+                    "name": "plan.stage", "ph": "X", "ts": t0_us,
+                    "dur": end_us - t0_us,
+                    "args": {"plan": self.plan_id, "lanes": lanes}})
         return staged
 
     # -- programs ----------------------------------------------------------
@@ -452,13 +596,15 @@ class ExecutionPlan:
         :class:`PlanError` covering every lane and ``collect()``
         raises."""
         tracing = obs_trace.enabled()
+        ctrl = self._ctrl
+        stamp = tracing or ctrl is not None
         with self._lock:
             ticket = PlanTicket(program.label, lanes, n_live, on_done,
                                 seq=next(self._seq),
                                 request_ids=request_ids)
             ticket._program = program
             ticket._restage = restage
-            ticket._t_dispatch_us = obs_trace.now_us() if tracing else 0.0
+            ticket._t_dispatch_us = obs_trace.now_us() if stamp else 0.0
             try:
                 if _faults.armed():
                     _faults.check("plan.submit", label=program.label,
@@ -469,7 +615,7 @@ class ExecutionPlan:
             except Exception as exc:  # noqa: BLE001 — recovery at fence
                 ticket._exc = exc
             self._window.append(ticket)
-            if tracing:
+            if stamp:
                 # host dispatch cost only: _run returned, nothing fenced
                 end_us = obs_trace.now_us()
                 args_kw = dict(plan=self.plan_id, seq=ticket.seq,
@@ -477,19 +623,28 @@ class ExecutionPlan:
                                live=n_live, inflight=len(self._window))
                 if request_ids is not None:
                     args_kw["request_ids"] = list(request_ids)
-                obs_trace.complete("plan.submit", ticket._t_dispatch_us,
-                                   end_us - ticket._t_dispatch_us,
-                                   **args_kw)
+                if tracing:
+                    obs_trace.complete("plan.submit",
+                                       ticket._t_dispatch_us,
+                                       end_us - ticket._t_dispatch_us,
+                                       **args_kw)
+                if ctrl is not None:
+                    ctrl.ingest({
+                        "name": "plan.submit", "ph": "X",
+                        "ts": ticket._t_dispatch_us,
+                        "dur": end_us - ticket._t_dispatch_us,
+                        "args": args_kw})
             self._obs_batches.inc(label=program.label)
+            self._labels.add(program.label)
             self._gauge.set(float(len(self._window)))
-            window = max(int(self.options.inflight), 1)
-            while len(self._window) > window:
-                self._complete_oldest()
-            return ticket
+        # fence window overflow OUTSIDE the dispatch lock: the device
+        # wait (+ recovery + on_done) must never serialize submitters
+        self._trim_window()
+        return ticket
 
     def collect(self, ticket: PlanTicket):
-        """Fence batches (oldest first) until this ticket completes;
-        returns its result pytree (device computation finished).
+        """Fence batches until this ticket completes; returns its
+        result pytree (device computation finished).
 
         A batch that failed and could not produce any results (no
         ``restage`` callback, or every lane guilty) raises its
@@ -501,64 +656,137 @@ class ExecutionPlan:
             with self._lock:
                 if ticket._done:  # fenced by a concurrent collector
                     break
-                if not self._window:
+                pending = ticket in self._window
+                if not pending and not ticket._fencing:
                     raise RuntimeError(
                         f"ticket for {ticket.label!r} is neither in "
                         "flight nor complete — was it submitted "
                         "through this plan?")
-                self._complete_oldest()
+            if pending:
+                self._fence_next(prefer=ticket)
+            else:
+                # popped by a concurrent fencer mid-completion: park on
+                # the ticket's event (set even when on_done raises), so
+                # an observed-popped ticket is always observed complete
+                ticket._event.wait()
         if ticket.result is None and ticket.error is not None:
             raise ticket.error
         return ticket.result
 
     def drain(self) -> int:
-        """Fence every in-flight batch; returns how many were fenced."""
+        """Fence every in-flight batch; returns how many this caller
+        fenced (concurrent fencers may retire the rest)."""
         n = 0
-        with self._lock:
-            while self._window:
-                self._complete_oldest()
-                n += 1
+        while self._fence_next() is not None:
+            n += 1
         return n
 
+    # -- fencing -----------------------------------------------------------
+
+    def _select_index(self, prefer: Optional[PlanTicket]) -> int:
+        """Window index of the next ticket to fence (caller holds the
+        window lock).  FIFO always picks the oldest; ``"ready"`` picks
+        the oldest batch whose readiness probe reports complete, then
+        the preferred (collected) ticket, then falls back to FIFO."""
+        if self.options.schedule != "ready" or len(self._window) <= 1:
+            return 0
+        for i, t in enumerate(self._window):
+            if _ticket_ready(t):
+                return i
+        if prefer is not None:
+            for i, t in enumerate(self._window):
+                if t is prefer:
+                    return i
+        return 0
+
+    def _trim_window(self) -> None:
+        while True:
+            with self._lock:
+                if len(self._window) <= self._window_limit():
+                    return
+            if self._fence_next() is None:
+                return
+
+    def _fence_next(self,
+                    prefer: Optional[PlanTicket] = None
+                    ) -> Optional[PlanTicket]:
+        """Retire one dispatched batch (schedule picks which); None
+        when the window is empty.  The fence lock serializes retiring
+        fencers — on_done callbacks and fence-order annotations stay
+        ordered — while submitters only ever need the window lock."""
+        with self._fence_lock:
+            with self._lock:
+                if not self._window:
+                    return None
+                idx = self._select_index(prefer)
+                if idx:
+                    chosen = self._window[idx]
+                    del self._window[idx]
+                    self._window.appendleft(chosen)
+            return self._complete_oldest()
+
     def _complete_oldest(self) -> PlanTicket:
-        # callers (submit/collect/drain) hold the window lock; keep it
-        # for the whole fence + recovery + on_done so a ticket observed
-        # popped is always observed completed (no-hang under threads)
-        ticket = self._window.popleft()
+        # the scheduled ticket sits at the window head (callers hold
+        # the fence lock; _fence_next moved its pick to the front).
+        # Hold the window lock ONLY for the pop: the device wait,
+        # recovery, and on_done all run outside it, so submitters and
+        # an on_done that re-submits never block on a fence in
+        # progress.
+        with self._lock:
+            ticket = self._window.popleft()
+            ticket._fencing = True
+            inflight_after = len(self._window)
+            self._gauge.set(float(inflight_after))
         tracing = obs_trace.enabled()
-        t_fence_us = obs_trace.now_us() if tracing else 0.0
+        ctrl = self._ctrl
+        stamp = tracing or ctrl is not None
+        t_fence_us = obs_trace.now_us() if stamp else 0.0
         try:
-            if ticket._exc is not None:
-                exc, ticket._exc = ticket._exc, None
-                raise exc
-            if _faults.armed():
-                _faults.check("plan.fence", label=ticket.label,
-                              request_ids=ticket.request_ids)
-            ticket.result = jax.block_until_ready(ticket._raw)
-        except Exception as exc:  # noqa: BLE001 — the failure domain
-            self._recover(ticket, exc)
-        ticket._raw = None
-        ticket._done = True
-        self._gauge.set(float(len(self._window)))
-        if tracing:
-            end_us = obs_trace.now_us()
-            # the fence span is the host's wait on the device; the
-            # dispatch span is the batch's full submit -> done window
-            obs_trace.complete(
-                "plan.fence", t_fence_us, end_us - t_fence_us,
-                plan=self.plan_id, seq=ticket.seq, label=ticket.label,
-                lanes=ticket.lanes, inflight=len(self._window))
-            args_kw = dict(plan=self.plan_id, seq=ticket.seq,
-                           label=ticket.label, lanes=ticket.lanes,
-                           live=ticket.n_live,
-                           inflight=len(self._window))
-            if ticket.request_ids is not None:
-                args_kw["request_ids"] = list(ticket.request_ids)
-            obs_trace.complete(
-                "plan.dispatch", ticket._t_dispatch_us,
-                end_us - ticket._t_dispatch_us, **args_kw)
-        if ticket._on_done is not None:
-            ticket._on_done(ticket)
+            try:
+                if ticket._exc is not None:
+                    exc, ticket._exc = ticket._exc, None
+                    raise exc
+                if _faults.armed():
+                    _faults.check("plan.fence", label=ticket.label,
+                                  request_ids=ticket.request_ids)
+                ticket.result = jax.block_until_ready(ticket._raw)
+            except Exception as exc:  # noqa: BLE001 — the failure domain
+                self._recover(ticket, exc)
+            ticket._raw = None
+            ticket._done = True
+            if stamp:
+                end_us = obs_trace.now_us()
+                order = next(self._fence_seq)
+                # the fence span is the host's wait on the device; the
+                # dispatch span is the batch's full submit -> done
+                # window.  ``order`` is the retirement rank — diffing
+                # it against ``seq`` shows out-of-order fences.
+                fence_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                                label=ticket.label, lanes=ticket.lanes,
+                                inflight=inflight_after, order=order)
+                if tracing:
+                    obs_trace.complete("plan.fence", t_fence_us,
+                                       end_us - t_fence_us, **fence_kw)
+                    args_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                                   label=ticket.label,
+                                   lanes=ticket.lanes,
+                                   live=ticket.n_live,
+                                   inflight=inflight_after)
+                    if ticket.request_ids is not None:
+                        args_kw["request_ids"] = list(ticket.request_ids)
+                    obs_trace.complete(
+                        "plan.dispatch", ticket._t_dispatch_us,
+                        end_us - ticket._t_dispatch_us, **args_kw)
+                if ctrl is not None:
+                    ctrl.ingest({
+                        "name": "plan.fence", "ph": "X",
+                        "ts": t_fence_us, "dur": end_us - t_fence_us,
+                        "args": fence_kw})
+            if ticket._on_done is not None:
+                ticket._on_done(ticket)
+        finally:
+            # always release waiters, even when on_done raised
+            ticket._event.set()
         return ticket
 
     # -- failure domain ----------------------------------------------------
@@ -592,6 +820,9 @@ class ExecutionPlan:
                 attempts=0, cause=exc)
             return
         _faults.note_recovered(exc)
+        if self._ctrl is not None:
+            # recovery backoff is congestion: shrink the window now
+            self._ctrl.on_backoff()
         indices = list(range(ticket.n_live))
         backoff_ms = max(float(self.options.retry_backoff_ms), 0.0)
         attempts = 0

@@ -106,6 +106,7 @@ from dispatches_tpu.serve.bucket import (
     params_signature,
     request_fingerprint,
 )
+from dispatches_tpu.serve import admission
 from dispatches_tpu.serve.metrics import (
     BucketStats,
     LatencyWindow,
@@ -197,6 +198,22 @@ class ServeOptions:
     #: degradation rung 2: refine-failed lanes per ``bf16x-f32`` bucket
     #: before new submissions redirect to an f32 twin bucket.
     degrade_refine_fails: int = 3
+    #: adaptive batch forming (``docs/serve.md`` admission policy):
+    #: per-bucket service-time estimates (cost-card prior + streaming
+    #: p95 of the dispatch→fence window) make ``max_wait_ms`` a soft
+    #: default — a bucket closes early when the marginal wait would
+    #: push its tightest deadline past the estimated service time, and
+    #: holds past ``max_wait_ms`` (up to ``hold_max_ms``) while
+    #: coalescing the expected next arrival is free.  Dispatch order
+    #: across buckets follows deadline slack.  Off by default: the
+    #: fixed-wait policy is bit-identical to the historical one.
+    adaptive_wait: bool = False
+    #: adaptive-wait hold cap: how long the oldest request of a
+    #: slack-rich bucket may wait in total (None = 4 × max_wait_ms).
+    hold_max_ms: Optional[float] = None
+    #: safety factor on the service-time estimate when judging whether
+    #: a deadline can still be met.
+    deadline_guard: float = 1.25
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeOptions":
@@ -221,6 +238,12 @@ class ServeOptions:
         raw = os.environ.get(flag_name("SERVE_DEGRADE_REFINE_FAILS"), "")
         if raw:
             env["degrade_refine_fails"] = int(raw)
+        raw = os.environ.get(flag_name("SERVE_ADAPTIVE_WAIT"), "")
+        if raw:
+            env["adaptive_wait"] = raw not in ("0", "false", "False")
+        raw = os.environ.get(flag_name("SERVE_HOLD_MAX_MS"), "")
+        if raw:
+            env["hold_max_ms"] = float(raw)
         env.update(overrides)
         return cls(**env)
 
@@ -395,6 +418,11 @@ class _Bucket:
             kind = "ipm"
         self.kind = kind
         self.stats = BucketStats(label)
+        # adaptive batch forming inputs: service-time estimate (cost
+        # -card prior + streaming p95 of dispatch→fence) and the EWMA
+        # inter-arrival gap — both cheap enough to feed unconditionally
+        self.est = admission.ServiceTimeEstimate(label)
+        self.arrivals = admission.ArrivalEstimate()
         # process-registry mirrors of the per-request windows (bound
         # cells: one observe per request) — this is what obs.slo grades
         self.obs_latency = obs_registry.histogram(
@@ -666,6 +694,7 @@ class SolveService:
         with self._lock:
             bucket.pending.append(handle)
             bucket.stats.record_submitted()
+            bucket.arrivals.observe(now)
             self._submitted += 1
         self._obs_submitted.inc()
         self._obs_queue_depth.set(float(self._queue_depth()))
@@ -730,14 +759,17 @@ class SolveService:
     # -- dispatch ----------------------------------------------------------
 
     def poll(self, now: Optional[float] = None) -> int:
-        """Flush every bucket whose oldest request exceeded max_wait_ms;
-        returns the number of requests dispatched or timed out."""
+        """Flush every bucket whose batch is due to close; returns the
+        number of requests dispatched or timed out.
+
+        A batch is due after ``max_wait_ms`` — or, with
+        ``adaptive_wait``, at the instant :meth:`_close_due_at`
+        computes from the bucket's service-time estimate and queued
+        deadlines.  Due buckets flush in deadline-slack order."""
         now = self._now() if now is None else now
-        wait_s = self.options.max_wait_ms / 1e3
         n = 0
-        for bucket in list(self._buckets.values()):
-            while bucket.pending and (
-                    now - bucket.pending[0].submitted_at >= wait_s):
+        for bucket in self._buckets_by_slack(now):
+            while bucket.pending and now >= self._close_due_at(bucket, now):
                 n += self._flush_bucket(bucket)
         if self._exporter is not None:
             self._exporter.maybe_export(now)
@@ -752,13 +784,81 @@ class SolveService:
         bounded by the plan's in-flight window), then the plan drains.
         Continuous batching falls out of the window: the plan fences
         its oldest batch exactly when a new dispatch needs the slot.
+        With ``adaptive_wait``, buckets dispatch in deadline-slack
+        order (tightest ``deadline − now − est_service`` first), so
+        the urgent batch never queues behind a slack-rich one.
         """
         n = 0
-        for bucket in list(self._buckets.values()):
+        for bucket in self._buckets_by_slack():
             while bucket.pending:
                 n += self._dispatch_bucket(bucket)[0]
         self.plan.drain()
         return n
+
+    # -- admission policy (adaptive batch forming) -------------------------
+
+    def _close_due_at(self, bucket: _Bucket, now: float) -> float:
+        """The instant this bucket's current batch should close.
+
+        Fixed policy: oldest request's age hits ``max_wait_ms``.
+        Adaptive policy (``ServeOptions.adaptive_wait``): close EARLY
+        when dispatching any later would push the tightest queued
+        deadline past the service-time estimate (guard-scaled), and
+        HOLD past ``max_wait_ms`` (never past ``hold_max_ms``) while
+        the expected next arrival would still meet every deadline —
+        coalescing it is free."""
+        oldest = bucket.pending[0]
+        wait_s = self.options.max_wait_ms / 1e3
+        due = oldest.submitted_at + wait_s
+        if not self.options.adaptive_wait:
+            return due
+        est_s = bucket.est.estimate_s()
+        guard = self.options.deadline_guard
+        deadlines = [r.deadline_at for r in bucket.pending
+                     if r.deadline_at is not None]
+        tightest = min(deadlines) if deadlines else None
+        if tightest is not None and est_s is not None:
+            # latest dispatch instant that still meets the tightest
+            # deadline; an already-hopeless batch closes immediately
+            # (triage completes expired requests as TIMEOUT)
+            latest_safe = tightest - est_s * guard
+            if latest_safe < due:
+                return max(latest_safe, oldest.submitted_at)
+        if len(bucket.pending) >= self.options.max_batch:
+            return now  # full batch: nothing left to coalesce
+        gap_s = bucket.arrivals.gap_s()
+        if gap_s is not None:
+            hold_ms = (self.options.hold_max_ms
+                       if self.options.hold_max_ms is not None
+                       else 4.0 * self.options.max_wait_ms)
+            hold_cap = oldest.submitted_at + hold_ms / 1e3
+            eta = now + gap_s
+            free = (tightest is None or est_s is None
+                    or eta + est_s * guard <= tightest)
+            if free:
+                return min(max(due, eta), hold_cap)
+        return due
+
+    def _buckets_by_slack(self, now: Optional[float] = None) -> List[_Bucket]:
+        """Dispatch order across buckets: tightest deadline slack
+        (``deadline − now − est_service``) first; buckets with no
+        queued deadlines last, FIFO among themselves.  The fixed
+        policy keeps the historical (creation) order — and reads no
+        clock (byte-identical telemetry under ticking test clocks)."""
+        buckets = list(self._buckets.values())
+        if not self.options.adaptive_wait:
+            return buckets
+        now = self._now() if now is None else now
+
+        def slack(bucket: _Bucket) -> float:
+            deadlines = [r.deadline_at for r in bucket.pending
+                         if r.deadline_at is not None]
+            if not deadlines:
+                return float("inf")
+            est_s = bucket.est.estimate_s() or 0.0
+            return min(deadlines) - now - est_s
+
+        return sorted(buckets, key=slack)
 
     def _queue_depth(self) -> int:
         return sum(len(b.pending) for b in self._buckets.values())
@@ -881,7 +981,7 @@ class SolveService:
             ticket = plan.submit(
                 bucket.program, args, n_live=len(live), lanes=lanes,
                 on_done=lambda t: self._complete_batch(
-                    bucket, live, lanes, dispatch_us, t),
+                    bucket, live, lanes, dispatch_us, now, t),
                 # request ids ride the plan lifecycle spans so a
                 # request's journey joins the batch that executed it
                 # (obs.timeline) — and, when faults are armed, let
@@ -976,7 +1076,8 @@ class SolveService:
                         "refine_fails": bucket.refine_fails})
 
     def _complete_batch(self, bucket: _Bucket, live: List[SolveHandle],
-                        lanes: int, dispatch_us: float, ticket) -> None:
+                        lanes: int, dispatch_us: float,
+                        dispatched_at: float, ticket) -> None:
         """Fence-time bookkeeping for one dispatched batch (runs from
         the plan's ``on_done``, after device completion).
 
@@ -990,6 +1091,10 @@ class SolveService:
         bucket.stats.record_batch(len(live), lanes)
         self._obs_batches.inc(bucket=label)
         end = self._clock()
+        # dispatch -> fence on the service clock trains the adaptive
+        # batch-close policy's service-time estimate (virtual-clock
+        # soaks included)
+        bucket.est.observe_ms((end - dispatched_at) * 1e3)
         end_us = obs_trace.now_us() if tracing else 0.0
         if tracing:
             # retroactive counterpart of the old fenced serve.batch
@@ -1152,6 +1257,8 @@ class SolveService:
             d["latency_ms"] = self._latency.summary_ms(bucket=b.stats.label)
             d["queue_wait_ms"] = self._queue_wait.summary_ms(
                 bucket=b.stats.label)
+            d["service_time_est_ms"] = b.est.estimate_ms()
+            d["service_time_samples"] = b.est.samples
             buckets[b.stats.label] = d
         cost_cards: Dict = {}
         try:  # per-bucket AOT cost cards, present only when profiling

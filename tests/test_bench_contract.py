@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r11_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r12_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -61,6 +61,12 @@ def test_preview_record_passes_schema(bench):
         assert key in out["chaos"]
     for key in bench.CHAOS_NONNULL_KEYS:
         assert out["chaos"][key] is not None
+    # the adaptive-scheduler A/B (r12, ISSUE 14)
+    for key in bench.SCHED_KEYS:
+        assert key in out["scheduler"]
+    for arm in ("fifo", "adaptive"):
+        for key in bench.SCHED_ARM_KEYS:
+            assert key in out["scheduler"][arm], (arm, key)
 
 
 def test_preview_soak_section(bench):
@@ -196,6 +202,37 @@ def test_preview_plan_timeline_overlap_direction(bench):
     # stall attribution shifts with the shape: the sync arm's wall is
     # almost all stall (every batch fully fenced before the next)
     assert plan["sync"]["stall_pct"] > plan["ahead"]["stall_pct"]
+    # the ISSUE-14 acceptance pin: the ahead arm's stall share must
+    # stay at or under 30% of wall (down from the r09 43% baseline) —
+    # this is the plan_stall_pct value the ledger gates lower-is-better
+    assert plan["ahead"]["stall_pct"] <= 30.0
+
+
+def test_preview_scheduler_ab(bench):
+    """The ISSUE-14 tentpole A/B, pinned on the measured preview: on
+    the head-of-line-blocking mix (one modeled-latency heavy batch
+    heading every ``heavy_period`` light ones, real host prep between
+    submits), ``schedule="ready"`` + the adaptive in-flight window beat
+    FIFO at a fixed window by >= 1.15x solves/s, retirement actually
+    left FIFO order (reorders split 0 vs positive), and out-of-order
+    fencing shaved the fifo arm's fence-bound stall share."""
+    out = json.load(open(PREVIEW))
+    sched = out["scheduler"]
+    fifo, adpt = sched["fifo"], sched["adaptive"]
+    assert sched["sps_ratio_adaptive_vs_fifo"] >= 1.15
+    assert sched["sps_ratio_adaptive_vs_fifo"] == pytest.approx(
+        adpt["solves_per_sec"] / fifo["solves_per_sec"], rel=1e-2)
+    # the mechanism, not just the headline: FIFO never reorders, the
+    # ready scheduler demonstrably does
+    assert fifo["fence_reorders"] == 0
+    assert adpt["fence_reorders"] > 0
+    assert adpt["fence_bound_share"] < fifo["fence_bound_share"]
+    # identical programs + data in both arms: bitwise result parity
+    assert sched["obj_max_abs_diff"] == 0.0
+    # the depth controller engaged: it grew past the fifo arm's fixed
+    # window and recorded its decision trail
+    assert adpt["final_inflight"] > sched["inflight"]
+    assert adpt["depth_decisions"]["grow"] >= 1
 
 
 def test_validate_rejects_missing_keys(bench):
@@ -310,6 +347,19 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["chaos"]
+    bench.validate_bench_output(out)
+    # scheduler (r12): optional-but-complete, both arms carry the full
+    # per-arm key set
+    out = json.load(open(PREVIEW))
+    del out["scheduler"]["sps_ratio_adaptive_vs_fifo"]
+    with pytest.raises(ValueError, match="sps_ratio_adaptive_vs_fifo"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["scheduler"]["adaptive"]["fence_reorders"]
+    with pytest.raises(ValueError, match="adaptive"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["scheduler"]
     bench.validate_bench_output(out)
 
 

@@ -260,6 +260,49 @@ def test_plan_retry_backoff_is_exponential_and_capped(monkeypatch):
     assert sleeps == [0.1, 0.2, 0.25, 0.25]
 
 
+def test_plan_ready_schedule_chaos_zero_hung_tickets():
+    """ISSUE-14 chaos contract: with out-of-order fencing and the
+    adaptive window armed, injected fence faults still leave zero hung
+    tickets — every batch retires, transients recover on retry, and
+    results stay bitwise-correct."""
+    plan, prog = _toy_plan(schedule="ready", inflight_max=4)
+    faults.arm("plan.fence,times=2")
+    inj0, rec0 = faults.injected_total(), faults.recovered_total()
+    tickets = [_submit_toy(plan, prog,
+                           [float(i + 1), float(i + 2)],
+                           request_ids=[10 * i, 10 * i + 1])
+               for i in range(4)]
+    results = [np.asarray(plan.collect(t)) for t in tickets]
+    assert plan.inflight == 0
+    for i, (ticket, res) in enumerate(zip(tickets, results)):
+        assert ticket.done()
+        np.testing.assert_allclose(res, [2.0 * (i + 1), 2.0 * (i + 2)])
+    # both injections were contained by the recovery ladder
+    assert faults.injected_total() - inj0 == 2
+    assert faults.recovered_total() - rec0 == 2
+
+
+def test_plan_ready_schedule_poison_isolated_without_hangs():
+    """A persistent poison lane under ``schedule="ready"``: bisection
+    still isolates exactly the guilty lane, innocents complete, and no
+    ticket — before, on, or after the poisoned batch — hangs."""
+    plan, prog = _toy_plan(schedule="ready", inflight_max=4)
+    faults.arm("plan.fence,poison_ids=21")
+    tickets = [_submit_toy(plan, prog,
+                           [float(i + 1), float(i + 2)],
+                           request_ids=[20 + 2 * i, 21 + 2 * i])
+               for i in range(3)]
+    # batch 0 rides ids [20, 21]: its lane 1 is the poisoned one
+    res0 = np.asarray(plan.collect(tickets[0]))
+    assert tickets[0].error.guilty == (1,)
+    assert np.isnan(res0[1]) and res0[0] == 2.0
+    for i in (1, 2):
+        res = np.asarray(plan.collect(tickets[i]))
+        assert tickets[i].error is None
+        np.testing.assert_allclose(res, [2.0 * (i + 1), 2.0 * (i + 2)])
+    assert plan.inflight == 0 and all(t.done() for t in tickets)
+
+
 # ---------------------------------------------------------------------------
 # serve failure domain (stub kernels, fake clock)
 # ---------------------------------------------------------------------------

@@ -10,6 +10,9 @@ placement for the mesh path) — so a staging or placement change that
 perturbs results bitwise fails here.
 """
 
+import threading
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -289,3 +292,272 @@ def test_parallel_parity_bitwise_vs_legacy(nlp):
             "fixed": {k: jax.device_put(jnp.asarray(v), repl)
                       for k, v in defaults["fixed"].items()}}
     np.testing.assert_array_equal(objs, np.asarray(legacy(args)))
+
+
+# ---------------------------------------------------------------------
+# adaptive scheduling: out-of-order fencing + in-flight depth (ISSUE 14)
+# ---------------------------------------------------------------------
+
+class _GatedBatch:
+    """A fake device future whose readiness is a host-controlled Event,
+    so a test decides exactly which in-flight batch looks complete.
+    Duck-types the two probes the plan uses: ``is_ready`` (the
+    ``schedule="ready"`` scan) and ``block_until_ready`` (the fence)."""
+
+    def __init__(self, gate, value):
+        self._gate = gate
+        self.value = value
+
+    def is_ready(self):
+        return self._gate.is_set()
+
+    def block_until_ready(self):
+        if not self._gate.wait(timeout=30.0):
+            raise TimeoutError("gated batch never released")
+        return self
+
+
+class _GatedProgram:
+    """Duck-typed PlanProgram: ``_run`` hands out the next pre-built
+    gated batch (submit only touches ``label`` and ``_run``)."""
+
+    donate_argnums = ()
+
+    def __init__(self, batches):
+        self.label = "plan.gated"
+        self._batches = list(batches)
+
+    def _run(self, *args):
+        return self._batches.pop(0)
+
+
+def test_ready_schedule_fences_completed_batch_first():
+    gates = [threading.Event() for _ in range(3)]
+    prog = _GatedProgram([_GatedBatch(g, i) for i, g in enumerate(gates)])
+    plan = ExecutionPlan(PlanOptions(inflight=2, schedule="ready",
+                                     mesh=None, donate=False))
+    t0 = plan.submit(prog, (), n_live=1, lanes=1)
+    t1 = plan.submit(prog, (), n_live=1, lanes=1)
+    gates[1].set()  # batch 1 completes while batch 0 is still running
+    t2 = plan.submit(prog, (), n_live=1, lanes=1)  # overflow: trim one
+    # the ready scheduler skipped the busy head and retired batch 1
+    assert t1.done() and not t0.done() and not t2.done()
+    for g in gates:
+        g.set()
+    plan.drain()
+    assert t0.done() and t2.done()
+    assert all(t.error is None for t in (t0, t1, t2))
+    assert [t.result.value for t in (t0, t1, t2)] == [0, 1, 2]
+
+
+def test_fifo_schedule_retires_in_order_even_when_later_ready():
+    gates = [threading.Event() for _ in range(3)]
+    prog = _GatedProgram([_GatedBatch(g, i) for i, g in enumerate(gates)])
+    plan = ExecutionPlan(PlanOptions(inflight=2, schedule="fifo",
+                                     mesh=None, donate=False))
+    t0 = plan.submit(prog, (), n_live=1, lanes=1)
+    t1 = plan.submit(prog, (), n_live=1, lanes=1)
+    gates[0].set()
+    gates[1].set()  # batch 1 is ready too — FIFO must ignore that
+    plan.submit(prog, (), n_live=1, lanes=1)
+    assert t0.done() and not t1.done()
+    gates[2].set()
+    plan.drain()
+
+
+def test_ready_vs_fifo_bitwise_parity_uneven_widths():
+    """Satellite 3: out-of-order fencing is a retirement-order change
+    only — per-ticket results and statuses are bitwise those of FIFO on
+    an uneven-width multi-batch run."""
+
+    def run_arm(schedule):
+        plan = ExecutionPlan(PlanOptions(
+            inflight=2, schedule=schedule,
+            inflight_max=4 if schedule == "ready" else None,
+            mesh=None, donate=False))
+        prog = plan.program(lambda a: a * 3.0 - 1.0, label="plan.parity",
+                            vmap_axes=0)
+        rng = np.random.default_rng(21)
+        tickets = []
+        for width in (5, 3, 8, 1):
+            arr = rng.uniform(-1.0, 1.0, (width, 4))
+            staged = plan.stage(jnp.asarray(arr), lanes=width,
+                                donate=False)
+            tickets.append(plan.submit(prog, (staged,), n_live=width,
+                                       lanes=width))
+        outs = [np.asarray(plan.collect(t)) for t in tickets]
+        return outs, [(t.done(), t.error) for t in tickets]
+
+    fifo_out, fifo_status = run_arm("fifo")
+    ready_out, ready_status = run_arm("ready")
+    assert fifo_status == ready_status
+    for a, b in zip(fifo_out, ready_out):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fence_wait_does_not_block_submitters():
+    """Satellite 1 regression: the device wait (and on_done) run
+    outside the window lock, so a submit issued while another thread is
+    parked in a fence must return immediately."""
+    gates = [threading.Event(), threading.Event()]
+    prog = _GatedProgram([_GatedBatch(gates[0], 0),
+                          _GatedBatch(gates[1], 1)])
+    plan = ExecutionPlan(PlanOptions(inflight=1, mesh=None, donate=False))
+    t0 = plan.submit(prog, (), n_live=1, lanes=1)
+    collector = threading.Thread(target=plan.collect, args=(t0,))
+    collector.start()
+    deadline = time.monotonic() + 10.0
+    while not t0._fencing and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert t0._fencing  # the fence is parked in block_until_ready
+    gates[1].set()
+    submitted = threading.Event()
+
+    def _submit():
+        plan.submit(prog, (), n_live=1, lanes=1)
+        submitted.set()
+
+    threading.Thread(target=_submit).start()
+    assert submitted.wait(5.0), "submit blocked behind a fence in progress"
+    gates[0].set()
+    collector.join(10.0)
+    assert t0.done() and t0.result.value == 0
+    plan.drain()
+
+
+def test_on_done_resubmit_chain_does_not_deadlock():
+    """An on_done that re-submits (continuous batching) re-enters the
+    plan from inside a fence; the reentrant fence lock plus the
+    outside-the-window-lock wait keep that deadlock-free."""
+    plan = ExecutionPlan(PlanOptions(inflight=1, mesh=None, donate=False))
+    prog = plan.program(lambda a: a + 1.0, label="plan.chain", vmap_axes=0)
+    seen = []
+
+    def submit_chain(i):
+        def on_done(ticket):
+            seen.append(np.asarray(ticket.result))
+            if i < 2:
+                submit_chain(i + 1)
+
+        staged = plan.stage(jnp.full((2, 3), float(i)), lanes=2,
+                            donate=False)
+        plan.submit(prog, (staged,), n_live=2, lanes=2, on_done=on_done)
+
+    submit_chain(0)
+    finished = threading.Event()
+
+    def _drain():
+        plan.drain()
+        finished.set()
+
+    threading.Thread(target=_drain, daemon=True).start()
+    assert finished.wait(60.0), "on_done re-submission deadlocked the plan"
+    assert len(seen) == 3
+    for i, arr in enumerate(seen):
+        np.testing.assert_array_equal(arr, np.full((2, 3), float(i) + 1.0))
+
+
+def test_plan_schedule_options_env_and_validation(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_PLAN_SCHEDULE", "ready")
+    monkeypatch.setenv("DISPATCHES_TPU_PLAN_INFLIGHT_MAX", "6")
+    opts = PlanOptions.from_env()
+    assert opts.schedule == "ready" and opts.inflight_max == 6
+    with pytest.raises(ValueError, match="schedule"):
+        PlanOptions(schedule="lifo")
+    plan = ExecutionPlan(PlanOptions(inflight=2, inflight_max=6, mesh=None))
+    assert plan.controller is not None
+    assert plan.inflight_limit == plan.controller.depth == 2
+    # fixed-window plans keep the static bound and no controller
+    fixed = ExecutionPlan(PlanOptions(inflight=3, mesh=None))
+    assert fixed.controller is None and fixed.inflight_limit == 3
+
+
+# ---------------------------------------------------------------------
+# the in-flight depth controller (pure host-side unit tests)
+# ---------------------------------------------------------------------
+
+def _ev(name, ts, dur, plan=1):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "args": {"plan": plan}}
+
+
+def _lifecycle(base, host_us, fence_us):
+    """One stage -> submit -> fence round starting at ``base`` (us)."""
+    return [_ev("plan.stage", base, host_us),
+            _ev("plan.submit", base + host_us, 5.0),
+            _ev("plan.fence", base + host_us + 5.0, fence_us)]
+
+
+def _controller(**kw):
+    from dispatches_tpu.plan.adaptive import InflightDepthController
+
+    kw.setdefault("plan", 1)
+    kw.setdefault("gauges", False)
+    return InflightDepthController(**kw)
+
+
+def test_depth_controller_grows_on_fence_dominance_and_caps():
+    ctrl = _controller(base=2, max_inflight=4, decide_every=1)
+    t = 0.0
+    for expected in (3, 4, 4):  # +1 per fence-bound interval, then cap
+        for ev in _lifecycle(t, host_us=10.0, fence_us=5000.0):
+            ctrl.ingest(ev)
+        assert ctrl.depth == expected
+        t += 10_000.0
+    assert ctrl.decisions == {"grow": 2, "shrink": 0, "hold": 1}
+
+
+def test_depth_controller_shrinks_multiplicatively_on_host_dominance():
+    ctrl = _controller(base=4, max_inflight=8, decide_every=1)
+    t = 0.0
+    for expected in (2, 1, 1):  # halve, halve, floor at 1
+        for ev in _lifecycle(t, host_us=5000.0, fence_us=10.0):
+            ctrl.ingest(ev)
+        assert ctrl.depth == expected
+        t += 10_000.0
+    assert ctrl.decisions["shrink"] == 2
+
+
+def test_depth_controller_backoff_shrinks_immediately():
+    ctrl = _controller(base=8, max_inflight=8)
+    ctrl.on_backoff()
+    assert ctrl.depth == 4  # no waiting for the decision window
+    ctrl.on_backoff()
+    assert ctrl.depth == 2
+    assert ctrl.decisions == {"grow": 0, "shrink": 2, "hold": 0}
+
+
+def test_depth_controller_memory_budget_gates_growth():
+    ctrl = _controller(base=2, max_inflight=8, decide_every=1,
+                       mem_budget_bytes=100, peak_bytes_fn=lambda: 60.0)
+    for ev in _lifecycle(0.0, host_us=10.0, fence_us=5000.0):
+        ctrl.ingest(ev)
+    # fence-bound, but 3 slots x 60 bytes would break the 100-byte
+    # budget: hold instead of grow
+    assert ctrl.depth == 2
+    assert ctrl.decisions == {"grow": 0, "shrink": 0, "hold": 1}
+    # an unknown peak (profiling off) leaves growth unconstrained
+    free = _controller(base=2, max_inflight=8, decide_every=1,
+                       mem_budget_bytes=100, peak_bytes_fn=lambda: None)
+    for ev in _lifecycle(0.0, host_us=10.0, fence_us=5000.0):
+        free.ingest(ev)
+    assert free.depth == 3
+
+
+def test_depth_controller_replay_is_deterministic():
+    rng = np.random.default_rng(5)
+    events, t = [], 0.0
+    for _ in range(12):
+        events.extend(_lifecycle(t, host_us=float(rng.uniform(5, 50)),
+                                 fence_us=float(rng.uniform(5, 5000))))
+        t += 10_000.0
+
+    def replay():
+        ctrl = _controller(base=2, max_inflight=6)
+        trail = []
+        for ev in events:
+            ctrl.ingest(ev)
+            trail.append(ctrl.depth)
+        return trail, dict(ctrl.decisions)
+
+    assert replay() == replay()

@@ -742,3 +742,120 @@ def test_flight_off_serve_deadline_path_untouched(nlp8, monkeypatch):
     assert doomed.result().status == RequestStatus.TIMEOUT
     assert live.result().status == RequestStatus.DONE
     assert calls == []  # never called: enabled() guards every hook
+
+
+# ---------------------------------------------------------------------
+# adaptive admission: deadline/cost-aware batch forming (ISSUE 14)
+# ---------------------------------------------------------------------
+
+class CountingClock(FakeClock):
+    def __init__(self):
+        super().__init__()
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        return self.t
+
+
+def test_fixed_policy_due_time_and_order_read_no_extra_clock(nlp8, nlp12):
+    """The historical policy is byte-preserved: a batch closes when the
+    OLDEST request ages past max_wait_ms, buckets dispatch in creation
+    order, and neither decision reads the clock beyond what poll()
+    already did (telemetry stays byte-identical under ticking clocks)."""
+    clock = CountingClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=5.0,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(16)
+    h8 = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                    base_solver=_toy_base_solver)
+    clock.advance(0.002)
+    h12 = svc.submit(nlp12, _price_params(nlp12, 12, rng), solver="ipm",
+                     base_solver=_toy_base_solver)
+    b8, b12 = h8._bucket, h12._bucket
+    reads = clock.reads
+    assert svc._close_due_at(b8, clock.t) == pytest.approx(
+        b8.pending[0].submitted_at + 0.005)
+    assert svc._buckets_by_slack() == [b8, b12]
+    assert clock.reads == reads  # fixed policy: zero clock reads
+
+
+def test_adaptive_wait_closes_early_for_tight_deadline(nlp8):
+    """Close-early: once the service-time estimate says waiting any
+    longer would push the tightest queued deadline past its dispatch
+    window, the batch closes — well before max_wait_ms."""
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=1000.0,
+                                    adaptive_wait=True, warm_start=False),
+                       clock=clock)
+    rng = np.random.default_rng(17)
+    h = svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                   base_solver=_toy_base_solver, deadline_ms=50.0)
+    bucket = h._bucket
+    for _ in range(8):
+        bucket.est.observe_ms(30.0)  # measured service time: 30 ms
+    # latest safe dispatch = deadline - guard * est = 50 - 1.25*30
+    assert svc._close_due_at(bucket, clock.t) == pytest.approx(0.0125)
+    assert svc.poll() == 0          # still coalescing
+    clock.advance(0.013)
+    assert svc.poll() == 1          # closed ~77x earlier than max_wait
+    assert h.result().status == RequestStatus.DONE
+
+
+def test_adaptive_wait_holds_while_next_arrival_is_free(nlp8):
+    """Hold-past-due: with no queued deadlines and a short expected
+    inter-arrival gap, coalescing one more request is free, so the
+    batch holds past max_wait_ms — but never past the hold cap."""
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=10.0,
+                                    adaptive_wait=True, warm_start=False),
+                       clock=clock)
+    rng = np.random.default_rng(18)
+    svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+               base_solver=_toy_base_solver)
+    clock.advance(0.004)            # arrival gap estimate: 4 ms
+    svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+               base_solver=_toy_base_solver)
+    clock.advance(0.008)            # t=12ms: past the fixed 10ms due
+    assert svc.poll() == 0          # held: next arrival (~16ms) is free
+    clock.advance(0.029)            # t=41ms: past the 4x-max_wait cap
+    assert svc.poll() == 2
+
+
+def test_adaptive_dispatch_orders_buckets_by_deadline_slack(nlp8, nlp12):
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=8, max_wait_ms=1e9,
+                                    adaptive_wait=True, warm_start=False),
+                       clock=clock)
+    rng = np.random.default_rng(19)
+    slack_rich = svc.submit(nlp8, _price_params(nlp8, 8, rng),
+                            solver="ipm", base_solver=_toy_base_solver,
+                            deadline_ms=1000.0)
+    tight = svc.submit(nlp12, _price_params(nlp12, 12, rng),
+                       solver="ipm", base_solver=_toy_base_solver,
+                       deadline_ms=20.0)
+    # created later, but the tighter slack dispatches first
+    assert svc._buckets_by_slack(clock.t) == [tight._bucket,
+                                              slack_rich._bucket]
+    no_deadline = svc.submit(_arbitrage_nlp(4), None, solver="ipm",
+                             base_solver=_toy_base_solver)
+    # deadline-free buckets sort last (infinite slack)
+    assert svc._buckets_by_slack(clock.t)[-1] is no_deadline._bucket
+
+
+def test_service_time_estimate_trains_at_fence(nlp8):
+    """Every completed dispatch feeds the bucket's service-time
+    estimator (on the service clock), and metrics() exposes it."""
+    clock = FakeClock()
+    svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                    warm_start=False), clock=clock)
+    rng = np.random.default_rng(20)
+    hs = [svc.submit(nlp8, _price_params(nlp8, 8, rng), solver="ipm",
+                     base_solver=_toy_base_solver) for _ in range(2)]
+    clock.advance(0.006)
+    assert svc.poll() == 2
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+    b = svc.metrics()["buckets"]["ipm#0"]
+    assert b["service_time_samples"] >= 1
+    assert b["service_time_est_ms"] is not None
+    assert b["service_time_est_ms"] >= 0.0

@@ -647,6 +647,26 @@ def test_soak_chaos_window_recovers_everything_no_hangs():
     assert "faults:" in obs_soak.format_soak_report(report)
 
 
+def test_soak_service_section_plumbs_scheduler_knobs():
+    """ISSUE-14 plumb: a soak spec can arm the ready scheduler, the
+    adaptive in-flight window, and adaptive admission on the replayed
+    service — the knobs echo in the report spec and the ready-mode
+    replay still completes every request."""
+    faults.reset()
+    report = obs_soak.run_soak({
+        "traffic": {"duration_s": 1.0, "rate_rps": 150.0},
+        "service": {"schedule": "ready", "inflight_max": 4,
+                    "adaptive_wait": True},
+    })
+    svc_spec = report["spec"]["service"]
+    assert svc_spec["schedule"] == "ready"
+    assert svc_spec["inflight_max"] == 4
+    assert svc_spec["adaptive_wait"] is True
+    c = report["requests"]
+    assert c["done"] == c["submitted"] > 0
+    assert c["hung"] == c["error"] == 0
+
+
 def test_soak_shed_queue_depth_sheds_without_hanging():
     faults.reset()
     report = obs_soak.run_soak({

@@ -561,4 +561,6 @@ def test_overlap_efficiency_is_a_gated_ledger_metric():
     from dispatches_tpu.obs import ledger
 
     assert ledger.GATED_METRICS["overlap_efficiency"] == +1
-    assert "plan_stall_pct" not in ledger.GATED_METRICS  # recorded only
+    # gated lower-is-better since the adaptive scheduler: fence-bound
+    # stall is what out-of-order fencing + the depth controller shrink
+    assert ledger.GATED_METRICS["plan_stall_pct"] == -1
