@@ -340,6 +340,22 @@ CHAOS_KEYS = ("n_requests", "requests_done", "errors", "shed", "hung",
               "p99_ratio_chaos_vs_baseline")
 CHAOS_NONNULL_KEYS = ("fault_recovery_rate", "soak_p99_ms")
 
+#: the durable-restart replay (ISSUE 15): the same virtual stub replay
+#: with the write-ahead journal + snapshots armed, wedged fences
+#: driving the fence watchdog, and a mid-replay kill (service AND plan
+#: dropped with no drain, successor rebuilt from the durable
+#: directory).  ``lost_request_rate`` is the fraction of accepted
+#: requests the crash lost (gated, lower is better — the durability
+#: contract is exactly 0) and ``restart_recovery_ms`` is the wall
+#: clock of snapshot restore + journal replay + resubmission (gated,
+#: lower is better).  ``hung`` must be 0 across the crash boundary and
+#: ``warm_hit_rate_post`` must stay within 10% of pre-crash.
+CRASH_RESTART_KEYS = ("n_requests", "requests_done", "open_at_crash",
+                      "recovered", "lost", "lost_request_rate",
+                      "restart_recovery_ms", "warm_hit_rate_pre",
+                      "warm_hit_rate_post", "hung")
+CRASH_RESTART_NONNULL_KEYS = ("lost_request_rate", "restart_recovery_ms")
+
 
 def validate_bench_output(out):
     """Raise ValueError when ``out`` breaks the single-line contract;
@@ -445,6 +461,18 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench chaos headline metrics must be measured, "
                 f"not null: {nulls}")
+    cr = out.get("crash_restart")
+    if cr is not None:
+        missing = [k for k in CRASH_RESTART_KEYS if k not in cr]
+        if missing:
+            raise ValueError(
+                f"bench crash_restart missing sub-keys: {missing}")
+        nulls = [k for k in CRASH_RESTART_NONNULL_KEYS
+                 if cr.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench crash_restart headline metrics must be "
+                f"measured, not null: {nulls}")
     return out
 
 
@@ -510,6 +538,14 @@ def _finalize_output(out):
             metrics["fault_recovery_rate"] = chaos["fault_recovery_rate"]
         if chaos.get("soak_p99_ms") is not None:
             metrics["chaos_p99_ms"] = chaos["soak_p99_ms"]
+        # crash-restart section: recovery latency and the lost-request
+        # fraction are gated (both lower is better; lost must stay 0 —
+        # the write-ahead journal's whole contract)
+        cr = out.get("crash_restart") or {}
+        if cr.get("restart_recovery_ms") is not None:
+            metrics["restart_recovery_ms"] = cr["restart_recovery_ms"]
+        if cr.get("lost_request_rate") is not None:
+            metrics["lost_request_rate"] = cr["lost_request_rate"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -1434,6 +1470,44 @@ def run_bench():
             }
     except Exception as exc:
         out["chaos_bench_error"] = str(exc)[:120]
+
+    # ---- durable-restart replay (ISSUE 15): the same virtual stub
+    # replay with the journal + snapshots armed, wedged fences driving
+    # the watchdog, and a kill at t=1 s — the successor rebuilds from
+    # the durable directory and must lose nothing.  restart_recovery_ms
+    # and lost_request_rate feed the gated ledger -------------------
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.obs import soak as obs_soak
+
+            cr_rep = obs_soak.run_soak({
+                "traffic": {"process": "poisson", "rate_rps": 150.0,
+                            "duration_s": 2.0, "seed": 17,
+                            "perturb": ["price"], "rho": 0.9,
+                            "sigma": 0.05},
+                "service": {"warm_start": True,
+                            "fence_timeout_ms": 50.0},
+                "restart": {"enabled": True, "crash_at_s": 1.0,
+                            "snapshot_interval_s": 0.5},
+                "faults": {"scenario": "plan.fence,hang_s=0.5,every=9",
+                           "start_s": 0.25, "stop_s": 1.75},
+            })
+            crreq = cr_rep["requests"]
+            crs = cr_rep["restart"]
+            out["crash_restart"] = {
+                "n_requests": crreq["submitted"],
+                "requests_done": crreq["done"],
+                "open_at_crash": crs["open_at_crash"],
+                "recovered": crs["recovered"],
+                "lost": crs["lost"],
+                "lost_request_rate": cr_rep["lost_request_rate"],
+                "restart_recovery_ms": cr_rep["restart_recovery_ms"],
+                "warm_hit_rate_pre": crs["warm_hit_rate_pre"],
+                "warm_hit_rate_post": crs["warm_hit_rate_post"],
+                "hung": crreq["hung"],
+            }
+    except Exception as exc:
+        out["crash_restart_bench_error"] = str(exc)[:120]
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------

@@ -149,6 +149,18 @@ REGISTERED_FLAGS = {
     "cap on how long a deadline-slack-rich bucket may hold beyond "
     "SERVE_MAX_WAIT_MS waiting to coalesce arrivals "
     "(serve.ServeOptions.from_env; default 4x SERVE_MAX_WAIT_MS)",
+    "SERVE_JOURNAL_DIR": "arm the solve-service write-ahead request "
+    "journal + learned-state snapshots in this directory; a service "
+    "built with recover_dir= resubmits every request that was open at "
+    "death (serve.journal; unset = no durability, zero overhead)",
+    "SERVE_SNAPSHOT_INTERVAL_S": "seconds between periodic learned-"
+    "state snapshots when the journal is armed (serve.snapshot; "
+    "default 30)",
+    "PLAN_FENCE_TIMEOUT_MS": "execution-plan fence watchdog: bound "
+    "every blocking fence on the plan clock; a batch that exceeds it "
+    "raises PlanError(kind='hang') into the retry/bisection domain "
+    "and shrinks the in-flight window "
+    "(plan.PlanOptions.from_env; unset = unbounded fences)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -181,6 +181,9 @@ CORPUS: Dict[str, Dict[str, str]] = {
             in_max = os.environ.get("DISPATCHES_TPU_PLAN_INFLIGHT_MAX")
             adw = os.environ.get("DISPATCHES_TPU_SERVE_ADAPTIVE_WAIT")
             hold = os.environ.get("DISPATCHES_TPU_SERVE_HOLD_MAX_MS")
+            jdir = os.environ.get("DISPATCHES_TPU_SERVE_JOURNAL_DIR")
+            snap = os.environ.get("DISPATCHES_TPU_SERVE_SNAPSHOT_INTERVAL_S")
+            fence = os.environ.get("DISPATCHES_TPU_PLAN_FENCE_TIMEOUT_MS")
         """,
     },
     "GL008": {

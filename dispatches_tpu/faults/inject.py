@@ -49,6 +49,14 @@ Rule fields:
                 poison a deterministic subset of soak traffic.
 ``skew_s``      ``service.clock`` only: seconds added to the service's
                 clock reads while the rule has fire budget.
+``hang_s``      plan sites only: the fence *wedges* for this many
+                seconds instead of raising — the plan consumes the
+                duration via its injectable clock, so a fence watchdog
+                (``PlanOptions.fence_timeout_ms``) can be proven to
+                escape a hang rather than wait it out.  Non-raising
+                like ``skew_s``: hang firings count in ``faults.hung``,
+                not ``faults.injected`` (recovery-rate accounting is
+                for raised faults).
 
 Raising sites raise :class:`InjectedFault` (a ``RuntimeError``) and
 increment the ``faults.injected`` counter (labeled by site); recovery
@@ -89,6 +97,8 @@ __all__ = [
     "reset",
     "check",
     "clock_skew",
+    "hang_for",
+    "hung_total",
     "note_recovered",
     "injected_total",
     "recovered_total",
@@ -115,6 +125,9 @@ _recovered = _registry.counter(
 _skewed = _registry.counter(
     "faults.skewed",
     "service clock reads skewed by a service.clock rule")
+_hung = _registry.counter(
+    "faults.hung",
+    "fences wedged by a hang_s rule (site=<injection site>)")
 
 
 class InjectedFault(RuntimeError):
@@ -142,6 +155,7 @@ class FaultRule:
     poison_ids: Tuple[int, ...] = ()
     poison_mod: Optional[int] = None
     skew_s: float = 0.0
+    hang_s: float = 0.0
     calls: int = 0
     fires: int = 0
     _rng: random.Random = field(default=None, repr=False)  # type: ignore
@@ -203,6 +217,8 @@ class FaultScenario:
         for rule in self.rules:
             if rule.site != site or rule.site == "service.clock":
                 continue
+            if rule.hang_s > 0.0:
+                continue  # hang rules are consumed via hang_for()
             if rule.should_fire(label, request_ids):
                 _injected.inc(site=site)
                 detail = rule.match or (
@@ -221,6 +237,19 @@ class FaultScenario:
                 skew += rule.skew_s
         return skew
 
+    def hang_for(self, site: str, label: Optional[str] = None,
+                 request_ids: Optional[Sequence[int]] = None) -> float:
+        """Total seconds the fence at ``site`` should wedge (0.0 when
+        no hang rule fires).  Non-raising, like :meth:`clock_skew`."""
+        hang = 0.0
+        for rule in self.rules:
+            if rule.site != site or rule.hang_s <= 0.0:
+                continue
+            if rule.should_fire(label, request_ids):
+                _hung.inc(site=site)
+                hang += rule.hang_s
+        return hang
+
     def __repr__(self):
         return f"FaultScenario({self.rules!r})"
 
@@ -229,7 +258,7 @@ _RuleSpec = Union[str, Dict, FaultRule]
 _ScenarioSpec = Union[str, Dict, Sequence[_RuleSpec], FaultScenario, None]
 
 _INT_FIELDS = ("times", "after", "every", "seed", "poison_mod")
-_FLOAT_FIELDS = ("p", "skew_s")
+_FLOAT_FIELDS = ("p", "skew_s", "hang_s")
 
 
 def _parse_rule(spec: _RuleSpec) -> FaultRule:
@@ -356,6 +385,20 @@ def clock_skew() -> float:
     if _SCENARIO is None:
         return 0.0
     return _SCENARIO.clock_skew()
+
+
+def hang_for(site: str, label: Optional[str] = None,
+             request_ids: Optional[Sequence[int]] = None) -> float:
+    """Seconds a ``hang_s`` rule wedges the fence at ``site`` (0.0
+    when disarmed or no rule fires)."""
+    if _SCENARIO is None:
+        return 0.0
+    return _SCENARIO.hang_for(site, label=label, request_ids=request_ids)
+
+
+def hung_total() -> float:
+    """Total hang_s firings so far (all sites; process-global)."""
+    return _hung.total()
 
 
 def note_recovered(exc: BaseException) -> None:

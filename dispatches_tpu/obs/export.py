@@ -45,7 +45,9 @@ __all__ = [
     "ExportOptions",
     "ContinuousExporter",
     "enabled",
+    "process_start_us",
     "render_prometheus",
+    "set_restart_generation",
     "PROM_FILE",
 ]
 
@@ -53,6 +55,32 @@ SCHEMA_VERSION = 1
 PROM_FILE = "metrics.prom"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# restart visibility (docs/robustness.md Durability): every
+# ``metrics.prom`` rewrite carries this process's start timestamp and
+# its recovery generation, so a scraper's ``changes()`` over either
+# series counts restarts — the SRE crash-loop alert input.
+_process_start_us: Optional[float] = None
+_restart_generation: int = 1
+
+
+def process_start_us() -> float:
+    """Wall-clock start stamp of this process (us since epoch, frozen
+    at first read)."""
+    global _process_start_us
+    if _process_start_us is None:
+        _process_start_us = time.time() * 1e6
+    return _process_start_us
+
+
+def set_restart_generation(generation: int) -> int:
+    """Record the service's recovery generation (stamped by
+    ``SolveService`` when it restores from a durability directory);
+    returns the previous value."""
+    global _restart_generation
+    prev = _restart_generation
+    _restart_generation = int(generation)
+    return prev
 
 
 def enabled() -> bool:
@@ -282,7 +310,17 @@ class ContinuousExporter:
                 pass
 
     def _write_prom(self) -> None:
-        text = render_prometheus(self._registry)
+        # appended after the registry render (not inside it) so the
+        # byte-pinned render_prometheus golden stays untouched
+        name = "dispatches_tpu_process_start_us"
+        text = (
+            render_prometheus(self._registry)
+            + f"# HELP {name} process start timestamp (us since epoch);"
+            " the generation label increments on journal/snapshot"
+            " recovery\n"
+            + f"# TYPE {name} gauge\n"
+            + f'{name}{{generation="{_restart_generation}"}}'
+            f" {_fmt(process_start_us())}\n")
         path = os.path.join(self.options.directory, PROM_FILE)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

@@ -87,6 +87,7 @@ TRIGGER_KINDS = (
     "solver_nonconverged",
     "burn_rate",
     "plan_error",       # batch dispatch/fence failure (serve ERROR path)
+    "plan_hang",        # fence watchdog escaped a wedged batch
     "warm_mispredict",  # warm start slower than the cold baseline
     "degrade",          # a graceful-degradation rung engaged
     "shed",             # load-shedding turned a submit away
@@ -339,12 +340,30 @@ def _bundle_paths(directory: str) -> List[str]:
 
 
 def _prune(directory: str, keep: Optional[int] = None) -> None:
+    """Bound the bundle directory: the OLDEST bundles are evicted so a
+    new trigger always lands (a recorder that goes blind after
+    ``MAX_BUNDLES`` would miss exactly the incident a long soak was
+    armed for).  Evictions are counted in ``flight.evicted`` so an
+    operator can tell "the onset bundle aged out" from "it never
+    fired"."""
     keep = MAX_BUNDLES if keep is None else keep  # read at call time
     paths = _bundle_paths(directory)
+    evicted = 0
     for p in paths[:max(0, len(paths) - keep)]:
         try:
             os.remove(p)
+            evicted += 1
         except OSError:
+            pass
+    if evicted:
+        try:
+            from dispatches_tpu.obs import registry as _registry
+
+            _registry.counter(
+                "flight.evicted", "flight bundles evicted (oldest "
+                "first) to keep the directory under MAX_BUNDLES"
+            ).inc(evicted)
+        except Exception:
             pass
 
 

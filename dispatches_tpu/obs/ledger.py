@@ -118,6 +118,13 @@ GATED_METRICS = {
     # costs the tail while faults are firing
     "fault_recovery_rate": +1,
     "chaos_p99_ms": -1,
+    # bench crash_restart section (ISSUE 15): wall-clock cost of
+    # rebuilding a service from its journal + snapshot, and the
+    # fraction of accepted requests the crash actually lost — the
+    # durability contract is exactly zero, so any rise is an escape
+    # from the write-ahead journal's replay path
+    "restart_recovery_ms": -1,
+    "lost_request_rate": -1,
 }
 
 _GIT_SHA: Optional[str] = None

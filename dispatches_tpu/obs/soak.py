@@ -133,7 +133,10 @@ def _soak_plan(options, clock: FakeClock, service_time_s):
                 clock.advance(service_time_s(self._window[0]))
             return super()._complete_oldest()
 
-    return _SoakPlan(options)
+    # the plan reads the virtual clock too: the fence watchdog
+    # (PlanOptions.fence_timeout_ms) and injected hang_s faults both
+    # consume virtual time, so hang scenarios soak deterministically
+    return _SoakPlan(options, clock=clock)
 
 
 # ---------------------------------------------------------------------------
@@ -159,12 +162,46 @@ class StubNLP:
         return {"p": {"price": np.array(self._price)}, "fixed": {}}
 
 
-def make_stub_solver():
+def make_stub_solver(warm: bool = False):
     """A jnp-traceable per-scenario ``solve(params)`` for the stub:
     objective and a deterministic params-dependent ``iters`` (so the
-    pdhg-iters drift detector has a real signal), always converged."""
+    pdhg-iters drift detector has a real signal), always converged.
+
+    ``warm=True`` returns the warm start contract variant —
+    ``solve(params, (x0, z0, kind))`` echoing ``x``/``z``/``start_kind``
+    with warm lanes converging in fewer iters — so soaks exercise the
+    serve warm-start machinery (``warm_contract`` bucket opts) and the
+    crash-restart scenario can measure warm-hit-rate continuity."""
     import jax.numpy as jnp
     from typing import NamedTuple
+
+    if warm:
+        from dispatches_tpu.solvers.pdlp import START_COLD
+
+        class WarmStubResult(NamedTuple):
+            x: object
+            z: object
+            obj: object
+            converged: object
+            iters: object
+            start_kind: object
+
+        def solve_warm(params, start):
+            x0, z0, kind = start
+            price = params["p"]["price"]
+            obj = jnp.sum(price)
+            # the "solution" tracks the params, so neighbor retrieval
+            # of a nearby request's x/z is a meaningful start
+            x = price + 0.0 * x0
+            z = jnp.mean(price) + 0.0 * z0
+            base = jnp.asarray(20.0 + 40.0 * jnp.mean(price),
+                               jnp.float32)
+            iters = jnp.where(kind == START_COLD, base, 0.4 * base)
+            return WarmStubResult(
+                x=x, z=z, obj=obj, converged=jnp.asarray(True),
+                iters=iters, start_kind=jnp.asarray(kind, jnp.int32))
+
+        return solve_warm
 
     class StubResult(NamedTuple):
         obj: object
@@ -201,7 +238,8 @@ DEFAULT_SPEC: Dict = {
         "rho": 0.9,
         "sigma": 0.05,
     },
-    "service": {"max_batch": 8, "max_wait_ms": 20.0, "inflight": 2},
+    "service": {"max_batch": 8, "max_wait_ms": 20.0, "inflight": 2,
+                "warm_start": False, "fence_timeout_ms": None},
     "service_time": {"base_ms": 2.0, "per_lane_ms": 0.25,
                      "jitter_ms": 0.5, "seed": 0, "spikes": []},
     "slo": {"latency_p99_ms": 200.0, "queue_wait_p95_ms": 100.0,
@@ -217,6 +255,13 @@ DEFAULT_SPEC: Dict = {
     # (the default) arms nothing — the baseline replay is untouched.
     "faults": {"scenario": None, "start_s": 0.0, "stop_s": None,
                "shed_queue_depth": None, "shed_on_burn": False},
+    # crash-restart (docs/robustness.md Durability): kill the service
+    # WITHOUT drain at crash_at_s of virtual time — in-flight batches
+    # and queued requests vanish exactly like a dead process — then
+    # rebuild from the durability directory (write-ahead journal +
+    # learned-state snapshot) and keep replaying.  Virtual mode only.
+    "restart": {"enabled": False, "crash_at_s": None,
+                "snapshot_interval_s": 1.0},
 }
 
 
@@ -307,13 +352,17 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         clk = clock if clock is not None else time.monotonic
 
     # -- service + plan ----------------------------------------------------
-    from dispatches_tpu.plan.execution import PlanOptions
+    from dispatches_tpu.plan.execution import ExecutionPlan, PlanOptions
 
     inflight_max = svc_cfg.get("inflight_max")
+    fence_timeout = svc_cfg.get("fence_timeout_ms")
     plan_opts = PlanOptions(
         inflight=int(svc_cfg.get("inflight", 2)),
         schedule=str(svc_cfg.get("schedule", "fifo")),
-        inflight_max=(None if inflight_max is None else int(inflight_max)))
+        inflight_max=(None if inflight_max is None else int(inflight_max)),
+        fence_timeout_ms=(None if fence_timeout is None
+                          else float(fence_timeout)))
+    model = None
     if virtual:
         model = ServiceTimeModel(
             base_ms=spec["service_time"]["base_ms"],
@@ -321,26 +370,50 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
             jitter_ms=spec["service_time"]["jitter_ms"],
             seed=int(spec["service_time"].get("seed", 0)),
             spikes=tuple(tuple(s) for s in spec["service_time"]["spikes"]))
-        plan = _soak_plan(plan_opts, clk, model.sampler(clk))
-    else:
-        from dispatches_tpu.plan.execution import ExecutionPlan
 
-        plan = ExecutionPlan(plan_opts)
-    service = SolveService(
-        ServeOptions(max_batch=int(svc_cfg["max_batch"]),
-                     max_wait_ms=float(svc_cfg["max_wait_ms"]),
-                     warm_start=False, plan=plan,
-                     shed_queue_depth=(None if shed_depth is None
-                                       else int(shed_depth)),
-                     adaptive_wait=bool(svc_cfg.get("adaptive_wait",
-                                                    False))),
-        clock=clk)
+    def _new_plan():
+        if virtual:
+            return _soak_plan(plan_opts, clk, model.sampler(clk))
+        return ExecutionPlan(plan_opts)
 
+    warm_on = bool(svc_cfg.get("warm_start", False))
+    submit_opts = None
     if nlp is None:
         nlp = StubNLP()
         if base_solver is None:
-            base_solver = make_stub_solver()
+            base_solver = make_stub_solver(warm=warm_on)
             solver = "pdlp"
+            if warm_on:
+                # opt the stub buckets into the serve warm machinery:
+                # the stub's start vectors are (n,)-primal, (1,)-dual
+                submit_opts = {"warm_contract": True,
+                               "warm_dims": (nlp.n, 1)}
+
+    # crash-restart durability directory (journal + snapshots)
+    restart_cfg = spec.get("restart") or {}
+    restart_enabled = bool(restart_cfg.get("enabled")) and virtual
+    durable_dir = None
+    if restart_enabled:
+        import os as _os
+        import tempfile as _tempfile
+
+        durable_dir = (_os.path.join(str(out_dir), "durable") if out_dir
+                       else _tempfile.mkdtemp(prefix="soak-durable-"))
+    snap_interval = float(restart_cfg.get("snapshot_interval_s") or 1.0)
+
+    def _serve_options(p):
+        return ServeOptions(
+            max_batch=int(svc_cfg["max_batch"]),
+            max_wait_ms=float(svc_cfg["max_wait_ms"]),
+            warm_start=warm_on, plan=p,
+            shed_queue_depth=(None if shed_depth is None
+                              else int(shed_depth)),
+            adaptive_wait=bool(svc_cfg.get("adaptive_wait", False)))
+
+    plan = _new_plan()
+    service = SolveService(
+        _serve_options(plan), clock=clk, journal_dir=durable_dir,
+        snapshot_interval_s=(snap_interval if durable_dir else None))
 
     # pre-compile the lane-count programs before any instrument is
     # attached: warmup latency is compile latency, not tail signal
@@ -348,6 +421,7 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         warm_defaults = nlp.default_params()
         for k in warmup_lanes:
             warm = [service.submit(nlp, warm_defaults, solver=solver,
+                                   options=submit_opts,
                                    base_solver=base_solver)
                     for _ in range(int(k))]
             service.flush_all()
@@ -422,6 +496,58 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     service._latency.record = _lat_record
     service._queue_wait.record = _qw_record
 
+    # -- crash-restart -----------------------------------------------------
+    restart_state: Dict = {"done": False, "info": None}
+    crash_at = restart_cfg.get("crash_at_s")
+
+    def _maybe_crash() -> None:
+        """Kill the service without drain at the spec'd virtual
+        instant, rebuild it from the durability directory, and splice
+        the recovered handles back into the replay."""
+        nonlocal service, orig_lat, orig_qw
+        if (not restart_enabled or restart_state["done"]
+                or crash_at is None or clk() < t0 + float(crash_at)):
+            return
+        restart_state["done"] = True
+        pre_warm = service.metrics()["warm_start"]
+        open_handles = [h for h in pending if not h.done()]
+        survivors = [h for h in pending if h.done()]
+        pending.clear()
+        pending.extend(survivors)
+        # the crash: drop the service AND its plan with no drain —
+        # queued requests and in-flight batches vanish exactly as if
+        # the process died; only the journal + snapshot survive
+        service._latency.record = orig_lat
+        service._queue_wait.record = orig_qw
+        t_wall = time.perf_counter()
+        service = SolveService(
+            _serve_options(_new_plan()), clock=clk,
+            recover_dir=durable_dir, recover_nlp=nlp,
+            recover_base_solver=base_solver,
+            snapshot_interval_s=snap_interval)
+        recovery_ms = (time.perf_counter() - t_wall) * 1e3
+        if fault_cfg.get("shed_on_burn"):
+            service.shed_signal = lambda: any(m.firing for m in monitors)
+        if exporter is not None:
+            service.attach_exporter(exporter)
+        orig_lat = service._latency.record
+        orig_qw = service._queue_wait.record
+        service._latency.record = _lat_record
+        service._queue_wait.record = _qw_record
+        pending.extend(service.recovered_handles)
+        rec = service.recovery or {}
+        recovered = int(rec.get("recovered", 0))
+        restart_state["info"] = {
+            "enabled": True,
+            "crash_at_s": float(crash_at),
+            "open_at_crash": len(open_handles),
+            "recovered": recovered,
+            "lost": max(len(open_handles) - recovered, 0),
+            "restart_recovery_ms": round(recovery_ms, 3),
+            "warm_hit_rate_pre": pre_warm["hit_rate"],
+            "generation": service.generation,
+        }
+
     # -- replay ------------------------------------------------------------
     requests = traffic_mod.generate(tspec, nlp.default_params())
     poll_dt = max(float(svc_cfg["max_wait_ms"]) / 1e3, 1e-3)
@@ -466,6 +592,7 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
 
     def _harvest() -> None:
         _fault_window(clk())
+        _maybe_crash()
         while pending and pending[0].done():
             h = pending.popleft()
             sr = h._result
@@ -512,8 +639,8 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                     service.poll()
                     _harvest()
             pending.append(service.submit(
-                nlp, req.params, solver=solver, base_solver=base_solver,
-                deadline_ms=req.deadline_ms))
+                nlp, req.params, solver=solver, options=submit_opts,
+                base_solver=base_solver, deadline_ms=req.deadline_ms))
             counts["submitted"] += 1
             _harvest()
         # drain the tail: one more wait quantum, then a pipelined flush
@@ -556,6 +683,17 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     terminal = (counts["done"] + counts["timeout"] + counts["error"]
                 + counts["shed"])
     counts["hung"] = counts["submitted"] - terminal
+    restart_report: Dict = {"enabled": bool(restart_enabled)}
+    lost_rate = None
+    recovery_ms = None
+    if restart_state["info"] is not None:
+        restart_report = dict(restart_state["info"])
+        restart_report["warm_hit_rate_post"] = (
+            service.metrics()["warm_start"]["hit_rate"])
+        lost_rate = (restart_report["lost"] / counts["submitted"]
+                     if counts["submitted"] else 0.0)
+        restart_report["lost_request_rate"] = round(lost_rate, 6)
+        recovery_ms = restart_report["restart_recovery_ms"]
     report = {
         "schema": SOAK_SCHEMA,
         "virtual": bool(virtual),
@@ -588,9 +726,12 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                 obs_registry.counter("serve.shed").total() - shed0),
             "recovery_rate": round(recovery_rate, 6),
         },
+        "restart": restart_report,
         "soak_p99_ms": lat_summary.get("p99"),
         "slo_burn_max": round(burn_max, 4),
         "fault_recovery_rate": round(recovery_rate, 6),
+        "restart_recovery_ms": recovery_ms,
+        "lost_request_rate": lost_rate,
     }
     if out_dir:
         import os
@@ -621,6 +762,15 @@ def format_soak_report(report: Dict) -> str:
             f"recovered (rate {fl['recovery_rate']:.3f}), "
             f"{fl['plan_retries']} plan retr{'y' if fl['plan_retries'] == 1 else 'ies'}, "
             f"{fl['shed']} shed")
+    rs = report.get("restart")
+    if rs and rs.get("enabled") and "open_at_crash" in rs:
+        lines.append(
+            f"restart: crash at {rs['crash_at_s']:.2f}s, "
+            f"{rs['open_at_crash']} open, {rs['recovered']} recovered, "
+            f"{rs['lost']} lost (rate {rs['lost_request_rate']:.4f}), "
+            f"recovery {rs['restart_recovery_ms']:.1f} ms, "
+            f"warm hit {rs['warm_hit_rate_pre']:.3f}"
+            f"->{rs['warm_hit_rate_post']:.3f}")
     s = report["latency_ms"]["streaming"]
     ph = report["latency_ms"]["posthoc"]
 

@@ -112,6 +112,10 @@ __all__ = ["PlanOptions", "PlanProgram", "PlanTicket", "PlanError",
 #: retry budget cannot stall the fence for seconds
 _BACKOFF_CAP_MS = 250.0
 
+#: an injected ``hang_s`` wedge on a REAL clock sleeps at most this
+#: long (virtual clocks advance the full duration instead)
+_HANG_SLEEP_CAP_S = 2.0
+
 
 @dataclass(frozen=True)
 class PlanOptions:
@@ -150,6 +154,12 @@ class PlanOptions:
     #: when ``peak_bytes × depth`` would exceed it (None = no budget;
     #: needs ``obs.profile`` enabled to bind).
     mem_budget_bytes: Optional[int] = None
+    #: fence watchdog: bound every blocking fence to this many
+    #: milliseconds of the plan's injectable clock.  A fence that
+    #: exceeds it is escaped with ``PlanError(kind="hang")`` into the
+    #: retry→bisection domain instead of wedging the pipeline forever.
+    #: None (default) = unbounded fences (the historical behavior).
+    fence_timeout_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.schedule not in ("fifo", "ready"):
@@ -180,6 +190,9 @@ class PlanOptions:
         raw = os.environ.get(flag_name("PLAN_INFLIGHT_MAX"), "")
         if raw:
             env["inflight_max"] = int(raw)
+        raw = os.environ.get(flag_name("PLAN_FENCE_TIMEOUT_MS"), "")
+        if raw:
+            env["fence_timeout_ms"] = float(raw)
         env.update(overrides)
         return cls(**env)
 
@@ -192,16 +205,24 @@ class PlanError(RuntimeError):
     the live batch, not request ids) whose isolated re-dispatch still
     failed — empty means the batch fully recovered on retry.  When no
     results could be produced at all (no ``restage`` callback, or every
-    lane guilty), ``collect()`` raises this error."""
+    lane guilty), ``collect()`` raises this error.
+
+    ``kind`` distinguishes failure classes: ``"error"`` (a raised
+    dispatch/fence exception) or ``"hang"`` (the fence watchdog
+    escaped a wedged batch — see ``PlanOptions.fence_timeout_ms``)."""
 
     def __init__(self, label: str, seq: int, guilty: Sequence[int] = (),
-                 attempts: int = 0, cause: Optional[BaseException] = None):
+                 attempts: int = 0, cause: Optional[BaseException] = None,
+                 kind: str = "error"):
         self.label = label
         self.seq = seq
         self.guilty = tuple(guilty)
         self.attempts = attempts
         self.cause = cause
+        self.kind = str(kind)
         msg = f"plan batch {label!r} seq {seq} failed"
+        if self.kind != "error":
+            msg += f" [{self.kind}]"
         if attempts:
             msg += f" after {attempts} retr{'y' if attempts == 1 else 'ies'}"
         if self.guilty:
@@ -361,8 +382,12 @@ class ExecutionPlan:
         result = plan.collect(ticket)
     """
 
-    def __init__(self, options: Optional[PlanOptions] = None):
+    def __init__(self, options: Optional[PlanOptions] = None, *,
+                 clock: Callable[[], float] = time.monotonic):
         self.options = options if options is not None else PlanOptions.from_env()
+        # the injectable clock bounds the fence watchdog (and is how
+        # virtual soaks express hang durations without wall time)
+        self._clock = clock
         mesh = self.options.mesh
         if mesh is None and (self.options.devices or 0) > 1:
             # lazy import: parallel.sharding is a plan caller
@@ -749,7 +774,7 @@ class ExecutionPlan:
                 if _faults.armed():
                     _faults.check("plan.fence", label=ticket.label,
                                   request_ids=ticket.request_ids)
-                ticket.result = jax.block_until_ready(ticket._raw)
+                ticket.result = self._fence(ticket)
             except Exception as exc:  # noqa: BLE001 — the failure domain
                 self._recover(ticket, exc)
             ticket._raw = None
@@ -789,6 +814,89 @@ class ExecutionPlan:
             ticket._event.set()
         return ticket
 
+    # -- fence watchdog ----------------------------------------------------
+
+    def _fence(self, ticket: PlanTicket):
+        """The blocking device wait, bounded by the fence watchdog.
+
+        With ``fence_timeout_ms`` unset this is exactly the historical
+        ``jax.block_until_ready``.  Armed, the wait is bounded on the
+        plan's injectable clock: an injected ``hang_s`` fault consumes
+        its duration from that clock first (virtual soaks advance a
+        FakeClock; real clocks sleep, capped), and a genuinely wedged
+        device wait is bounded by a readiness-probe poll loop.  Either
+        way a fence that exceeds the budget raises
+        ``PlanError(kind="hang")`` into :meth:`_recover` — the hang
+        joins the same retry→bisection→NaN-fill domain as any other
+        batch failure instead of stalling every request behind it."""
+        timeout_ms = self.options.fence_timeout_ms
+        timeout_s = None if timeout_ms is None else max(
+            float(timeout_ms), 0.0) / 1e3
+        if _faults.armed():
+            hang_s = _faults.hang_for("plan.fence", label=ticket.label,
+                                      request_ids=ticket.request_ids)
+            if hang_s > 0.0:
+                # the wedge holds the fence for hang_s — or until the
+                # watchdog budget runs out, whichever comes first
+                waited = hang_s if timeout_s is None else min(
+                    hang_s, timeout_s)
+                self._advance_clock(waited)
+                if timeout_s is not None and hang_s > timeout_s:
+                    self._hang_escape(ticket, timeout_ms)
+        if timeout_s is not None:
+            self._watch_fence(ticket, timeout_ms, timeout_s)
+        return jax.block_until_ready(ticket._raw)
+
+    def _advance_clock(self, seconds: float) -> None:
+        """Consume ``seconds`` from the injectable clock: virtual
+        clocks (anything with ``.advance``) jump; real clocks sleep,
+        capped so an injected multi-second hang cannot stall CI."""
+        adv = getattr(self._clock, "advance", None)
+        if adv is not None:
+            adv(seconds)
+        else:
+            time.sleep(min(seconds, _HANG_SLEEP_CAP_S))
+
+    def _watch_fence(self, ticket: PlanTicket, timeout_ms: float,
+                     timeout_s: float) -> None:
+        """Poll ticket readiness until complete or the budget expires.
+
+        Bounded on BOTH the injectable clock and wall time: a virtual
+        clock only moves when something advances it, so wall time is
+        the backstop that keeps a real wedge from spinning forever.
+        When the readiness probe is unavailable (None) the watchdog
+        cannot observe progress and falls through to the plain
+        blocking fence — bounding without a probe would mean guessing."""
+        t0 = self._clock()
+        wall0 = time.monotonic()
+        while True:
+            ready = _ticket_ready(ticket)
+            if ready is None or ready:
+                return
+            if (self._clock() - t0 >= timeout_s
+                    or time.monotonic() - wall0 >= timeout_s):
+                self._hang_escape(ticket, timeout_ms)
+            time.sleep(min(timeout_s / 20.0, 0.001))
+
+    def _hang_escape(self, ticket: PlanTicket, timeout_ms: float) -> None:
+        """A fence exceeded its budget: flight-record the wedge,
+        shrink the dispatch window NOW (a hang is maximal congestion —
+        waiting for the stall attribution loop would keep feeding the
+        wedged device), and raise the hang into the failure domain."""
+        if self._ctrl is not None:
+            self._ctrl.on_backoff()
+        from dispatches_tpu.obs import flight as obs_flight
+
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "plan_hang", label=ticket.label,
+                detail={"plan": self.plan_id, "seq": ticket.seq,
+                        "lanes": ticket.lanes, "n_live": ticket.n_live,
+                        "fence_timeout_ms": float(timeout_ms),
+                        "request_ids": list(ticket.request_ids or ())})
+        raise PlanError(ticket.label, ticket.seq, kind="hang",
+                        guilty=(), attempts=0)
+
     # -- failure domain ----------------------------------------------------
 
     def _redispatch(self, ticket: PlanTicket, idxs: Sequence[int]):
@@ -812,12 +920,13 @@ class ExecutionPlan:
         ``ticket.error`` (always) and ``ticket.result`` (unless no lane
         could produce one)."""
         label = ticket.label
+        kind = getattr(exc, "kind", "error")
         if ticket._restage is None or ticket._program is None:
             # no host-side restage contract: nothing to retry with —
             # the error covers the whole batch and collect() raises it
             ticket.error = PlanError(
                 label, ticket.seq, guilty=tuple(range(ticket.n_live)),
-                attempts=0, cause=exc)
+                attempts=0, cause=exc, kind=kind)
             return
         _faults.note_recovered(exc)
         if self._ctrl is not None:
@@ -838,7 +947,8 @@ class ExecutionPlan:
                 continue
             ticket.result = res
             ticket.error = PlanError(label, ticket.seq, guilty=(),
-                                     attempts=attempts, cause=exc)
+                                     attempts=attempts, cause=exc,
+                                     kind=kind)
             return
         # retries exhausted: bisect so every innocent lane still
         # completes and only the guilty ones fail
@@ -869,4 +979,4 @@ class ExecutionPlan:
                 lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                 *lanes_out)
         ticket.error = PlanError(label, ticket.seq, guilty=tuple(guilty),
-                                 attempts=attempts, cause=exc)
+                                 attempts=attempts, cause=exc, kind=kind)

@@ -1,0 +1,330 @@
+"""Write-ahead request journal: crash durability for the solve service.
+
+Every request the service *accepts* (past shedding and backpressure)
+is journaled before the caller's handle can complete: one ``accept``
+record carrying the full parameter payload (bitwise, base64 of the
+host buffer), the request fingerprint, the relative deadline, and the
+options signature — followed by ``status`` records as the request
+moves QUEUED → DISPATCHED → terminal.  A service that dies mid-flight
+leaves a journal whose non-terminal requests are exactly the ones a
+fresh process must resubmit; :func:`replay` reconstructs that set,
+tolerating a torn final record (a crash mid-``write`` truncates the
+last line, never corrupts earlier ones), and deduplicates by
+fingerprint so replaying the same journal twice — or a journal that
+already contains a previous recovery's re-accepts — never submits a
+request twice.
+
+Layout and rotation: records are JSON lines appended to numbered
+segments (``journal-00001.jsonl`` …).  A segment is rotated after
+``segment_records`` records: the old file is flushed, fsynced and
+closed before the next is created with ``O_EXCL``, so rotation can
+never lose or duplicate a record — the only vulnerable byte span is
+the tail of the newest segment, which replay already treats as torn.
+A clean :meth:`RequestJournal.shutdown` (written by
+``SolveService.drain``) marks the journal so recovery can distinguish
+"nothing was lost" from "the process died".
+
+Journaling is gated on ``DISPATCHES_TPU_SERVE_JOURNAL_DIR``
+(registered in ``analysis.flags``) or an explicit ``journal_dir=``
+constructor argument; when disarmed the service holds no journal
+object and the hot paths pay one ``is None`` branch — spy-pinned in
+``tests/test_durability.py`` exactly like flight/export.
+
+Host-side and numpy-only by design: the codec must round-trip the
+parameter pytree *bitwise* (the fingerprint of the resubmitted params
+must equal the journaled fingerprint) so arrays serialize as
+``(shape, dtype.str, base64(contiguous bytes))`` and tuples/lists are
+tagged to survive JSON.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+
+__all__ = [
+    "JournalReplay",
+    "RequestJournal",
+    "decode_tree",
+    "default_dir",
+    "enabled",
+    "encode_tree",
+    "replay",
+]
+
+SCHEMA_VERSION = 1
+DEFAULT_SEGMENT_RECORDS = 512
+_SEGMENT_PREFIX = "journal-"
+_SEGMENT_SUFFIX = ".jsonl"
+
+#: statuses that end a request's life — anything else is open at death
+TERMINAL_STATUSES = ("DONE", "TIMEOUT", "ERROR", "SHED")
+
+
+def enabled() -> bool:
+    """True when ``DISPATCHES_TPU_SERVE_JOURNAL_DIR`` names a directory."""
+    return bool(os.environ.get(flag_name("SERVE_JOURNAL_DIR")))
+
+
+def default_dir() -> Optional[str]:
+    """The env-configured journal directory, or None."""
+    return os.environ.get(flag_name("SERVE_JOURNAL_DIR")) or None
+
+
+# ---------------------------------------------------------------------------
+# payload codec: bitwise pytree round-trip through JSON
+# ---------------------------------------------------------------------------
+
+
+def _encode_leaf(leaf) -> Dict:
+    arr = np.ascontiguousarray(np.asarray(leaf))
+    return {
+        "__nd__": [
+            list(arr.shape),
+            arr.dtype.str,
+            base64.b64encode(arr.tobytes()).decode("ascii"),
+        ]
+    }
+
+
+def encode_tree(tree):
+    """Encode a params pytree (dicts/lists/tuples of arrays and
+    scalars) into a JSON-safe structure, bitwise-reversible."""
+    if isinstance(tree, dict):
+        return {str(k): encode_tree(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return {"__tuple__": [encode_tree(v) for v in tree]}
+    if isinstance(tree, list):
+        return [encode_tree(v) for v in tree]
+    if tree is None or isinstance(tree, (bool, int, float, str)):
+        return tree
+    return _encode_leaf(tree)
+
+
+def decode_tree(obj):
+    """Inverse of :func:`encode_tree`."""
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            shape, dtype, b64 = obj["__nd__"]
+            buf = base64.b64decode(b64.encode("ascii"))
+            return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(
+                tuple(shape)).copy()
+        if "__tuple__" in obj:
+            return tuple(decode_tree(v) for v in obj["__tuple__"])
+        return {k: decode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_tree(v) for v in obj]
+    return obj
+
+
+def _decode_options(opts):
+    """Journal options round-trip: JSON turns tuples into lists, but
+    option values must stay hashable (they feed ``freeze_options``), so
+    lists come back as tuples."""
+    if opts is None:
+        return None
+    out = {}
+    for key, value in opts.items():
+        if isinstance(value, list):
+            value = tuple(tuple(v) if isinstance(v, list) else v
+                          for v in value)
+        out[key] = value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+
+class RequestJournal:
+    """Append-only write-ahead journal with atomic segment rotation."""
+
+    def __init__(self, directory: str, *,
+                 segment_records: int = DEFAULT_SEGMENT_RECORDS):
+        if not directory:
+            raise ValueError("RequestJournal needs a directory")
+        self.directory = str(directory)
+        self.segment_records = max(int(segment_records), 1)
+        os.makedirs(self.directory, exist_ok=True)
+        self._records_in_segment = 0
+        self._fh = None
+        self._seg = self._next_segment_index()
+        self._open_segment()
+
+    # -- segment plumbing ---------------------------------------------------
+
+    def _next_segment_index(self) -> int:
+        top = 0
+        for name in os.listdir(self.directory):
+            if name.startswith(_SEGMENT_PREFIX) and \
+                    name.endswith(_SEGMENT_SUFFIX):
+                try:
+                    top = max(top, int(
+                        name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]))
+                except ValueError:
+                    continue
+        return top + 1
+
+    def _segment_path(self, seg: int) -> str:
+        return os.path.join(
+            self.directory, f"{_SEGMENT_PREFIX}{seg:05d}{_SEGMENT_SUFFIX}")
+
+    def _open_segment(self) -> None:
+        # O_EXCL: a rotation either fully creates the next segment or
+        # fails loudly — no half-rotated state to replay around.
+        fd = os.open(self._segment_path(self._seg),
+                     os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        self._fh = os.fdopen(fd, "w", encoding="utf-8")
+        self._records_in_segment = 0
+        self._write({"k": "h", "schema": SCHEMA_VERSION, "seg": self._seg})
+
+    def _rotate(self) -> None:
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._seg += 1
+        self._open_segment()
+
+    def _write(self, rec: Dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        self._records_in_segment += 1
+        if self._records_in_segment >= self.segment_records:
+            self._rotate()
+
+    # -- record kinds -------------------------------------------------------
+
+    def accept(self, request_id: int, fingerprint: str, *, solver: str,
+               options: Optional[Dict], deadline_ms: Optional[float],
+               t: float, params) -> None:
+        """Journal an accepted request (status QUEUED) with its full
+        payload — written before the request can possibly complete."""
+        self._write({
+            "k": "a",
+            "id": int(request_id),
+            "fp": fingerprint,
+            "solver": solver,
+            "opts": options,
+            "deadline_ms": deadline_ms,
+            "t": float(t),
+            "params": encode_tree(params),
+        })
+
+    def status(self, request_ids: Sequence[int], status: str) -> None:
+        """Journal a status transition for a batch of requests."""
+        self._write({
+            "k": "s",
+            "ids": [int(i) for i in request_ids],
+            "st": str(status),
+        })
+
+    def shutdown(self, clean: bool = True) -> None:
+        """Journal the clean-shutdown marker (written by ``drain``)."""
+        self._write({"k": "x", "clean": bool(clean)})
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+class JournalReplay:
+    """The reconstructed journal state: what to resubmit, and counts."""
+
+    def __init__(self):
+        self.accepted = 0            # accept records seen (pre-dedupe)
+        self.torn = 0                # undecodable lines skipped
+        self.clean_shutdown = False  # a clean marker was the last word
+        #: open requests in original accept order, deduped by
+        #: fingerprint: list of dicts with fp/solver/opts/deadline_ms/
+        #: params (decoded) ready for resubmission
+        self.open_requests: List[Dict] = []
+        self.lost = 0                # accepts whose payload failed decode
+
+
+def _segments(directory: str) -> List[str]:
+    names = [n for n in os.listdir(directory)
+             if n.startswith(_SEGMENT_PREFIX)
+             and n.endswith(_SEGMENT_SUFFIX)]
+    return [os.path.join(directory, n) for n in sorted(names)]
+
+
+def replay(directory: str) -> JournalReplay:
+    """Reconstruct the set of requests that were QUEUED or DISPATCHED
+    when the journal went quiet.
+
+    Torn records (a line that fails to parse — the tail of a segment
+    truncated by a crash mid-write) are counted and skipped; every
+    record before the tear was flushed whole, so nothing earlier is at
+    risk.  Duplicate accepts for the same fingerprint collapse to the
+    newest (idempotent replay), and a fingerprint with *any* terminal
+    status is closed.
+    """
+    out = JournalReplay()
+    if not os.path.isdir(directory):
+        return out
+    accepts: Dict[str, Dict] = {}      # fp -> newest accept record
+    order: List[str] = []              # fps in first-accept order
+    status_of: Dict[int, str] = {}     # request id -> latest status
+    ids_of: Dict[str, List[int]] = {}  # fp -> its request ids
+    for path in _segments(directory):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    out.torn += 1
+                    continue
+                kind = rec.get("k")
+                if kind == "a":
+                    out.accepted += 1
+                    out.clean_shutdown = False
+                    fp = rec["fp"]
+                    if fp not in accepts:
+                        order.append(fp)
+                    accepts[fp] = rec
+                    ids_of.setdefault(fp, []).append(int(rec["id"]))
+                    status_of[int(rec["id"])] = "QUEUED"
+                elif kind == "s":
+                    for rid in rec.get("ids", ()):
+                        status_of[int(rid)] = rec["st"]
+                elif kind == "x":
+                    out.clean_shutdown = bool(rec.get("clean"))
+    if out.clean_shutdown:
+        return out
+    for fp in order:
+        ids = ids_of.get(fp, ())
+        if any(status_of.get(i) in TERMINAL_STATUSES for i in ids):
+            continue
+        rec = accepts[fp]
+        try:
+            params = decode_tree(rec["params"])
+        except Exception:
+            out.lost += 1
+            continue
+        out.open_requests.append({
+            "fp": fp,
+            "solver": rec.get("solver") or "pdlp",
+            "options": _decode_options(rec.get("opts")),
+            "deadline_ms": rec.get("deadline_ms"),
+            "params": params,
+        })
+    return out

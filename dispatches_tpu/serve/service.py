@@ -107,6 +107,8 @@ from dispatches_tpu.serve.bucket import (
     request_fingerprint,
 )
 from dispatches_tpu.serve import admission
+from dispatches_tpu.serve import journal as journal_mod
+from dispatches_tpu.serve import snapshot as snapshot_mod
 from dispatches_tpu.serve.metrics import (
     BucketStats,
     LatencyWindow,
@@ -378,17 +380,27 @@ class _Bucket:
         # (env override included) — telemetry for tests/stats
         self.precision = resolve_pdlp_precision(opts.get("precision"))
         base = opts.pop("base_solver", None)
+        # caller-supplied base_solver opt-in to the warm start contract
+        # (``base(params, (x0, z0, kind))`` echoing x/z/start_kind/
+        # iters) — warm_dims declares the (n, m) start-vector sizes the
+        # service cannot derive from an opaque callable
+        warm_contract = bool(opts.pop("warm_contract", False))
+        warm_dims = opts.pop("warm_dims", None)
         # cross-request PDLP warm starts: only for service-built pdlp
         # solvers (a caller-supplied base_solver has an unknown start
         # contract), gated by the service warm_start policy AND the
         # DISPATCHES_TPU_WARMSTART kill-switch
         self.warm = False
-        warm_data = None
+        warm_nm = None  # (n, m) start-vector dims for warm-capable pdlp
         warm_dtype = np.float64
         if base is not None:
             # caller-built per-scenario solver (e.g. the bidder's
             # already-autoscaled IPM); caller declares the kind
             kind = "ipm" if kind in ("auto", "ipm", "ipopt") else "pdlp"
+            if (kind == "pdlp" and warm_start and warm_contract
+                    and warm_dims is not None):
+                warm_nm = (int(warm_dims[0]), int(warm_dims[1]))
+                warm_dtype = np.dtype(opts.get("dtype", "float64"))
         elif kind in ("auto", "pdlp", "cbc"):
             lp_kw = {k: v for k, v in opts.items() if k in _PDLP_FIELDS}
             lp_kw.setdefault("tol", 1e-8)
@@ -399,7 +411,9 @@ class _Bucket:
                                         lp_data=lp_data)
                 kind = "pdlp"
                 if warm_start:
-                    warm_data = lp_data
+                    warm_nm = (int(np.asarray(lp_data["lb"]).size),
+                               int(lp_data["K"].shape[0]
+                                   + lp_data["G"].shape[0]))
                     warm_dtype = np.dtype(lp_kw["dtype"])
             except ValueError:
                 if kind != "auto":
@@ -442,7 +456,7 @@ class _Bucket:
             self.program = plan.program(
                 base, label=f"serve.{label}", vmap_axes=(0, 0),
                 donate_argnums=(1,) if plan.options.donate else ())
-        elif warm_data is not None:
+        elif warm_nm is not None:
             # warm-capable pdlp bucket: every lane carries a
             # (x0, z0, kind) start — cold lanes pass zeros, which
             # reproduce the cold init arithmetic bit-for-bit, so one
@@ -451,8 +465,7 @@ class _Bucket:
             # alias the result's x/z/start_kind buffers); params carry
             # no alias-compatible output, exactly as on the ipm path.
             self.default_x0 = None
-            n = int(np.asarray(warm_data["lb"]).size)
-            m = int(warm_data["K"].shape[0] + warm_data["G"].shape[0])
+            n, m = warm_nm
             self.warm = True
             self.warm_dtype = warm_dtype
             self.warm_cold_start = (np.zeros(n, warm_dtype),
@@ -478,10 +491,24 @@ class SolveService:
 
     ``clock`` is injectable (defaults to ``time.monotonic``) so tests
     drive the max-wait / deadline policy deterministically.
+
+    Durability (``docs/robustness.md``): ``journal_dir`` (or
+    ``DISPATCHES_TPU_SERVE_JOURNAL_DIR``) arms the write-ahead request
+    journal and the periodic learned-state snapshot writer — one
+    directory holds both.  ``recover_dir`` rebuilds a service from a
+    predecessor's directory: the snapshot restores the warm-start
+    caches, admission estimators and degradation rungs; the journal's
+    non-terminal requests are resubmitted (idempotent by fingerprint)
+    through ``recover_nlp``/``recover_base_solver``, landing in
+    ``recovered_handles`` with counts in ``recovery``.
     """
 
     def __init__(self, options: Optional[ServeOptions] = None, *,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 journal_dir: Optional[str] = None,
+                 recover_dir: Optional[str] = None,
+                 recover_nlp=None, recover_base_solver=None,
+                 snapshot_interval_s: Optional[float] = None):
         self.options = options if options is not None else ServeOptions.from_env()
         self._clock = clock
         # the one dispatch path: placement, donation, and the
@@ -548,6 +575,78 @@ class SolveService:
                     clock=self._clock)
             except Exception:
                 self._exporter = None
+        # durability (docs/robustness.md): write-ahead journal +
+        # learned-state snapshots share one directory.  Disarmed, the
+        # hot paths pay one `is None` branch each (spy-pinned).
+        self.generation = 1
+        self._restored_buckets: Dict[str, Dict] = {}
+        self._draining = False
+        self.recovered_handles: List[SolveHandle] = []
+        self.recovery: Optional[Dict] = None
+        self._journal = None
+        self._snapshots = None
+        durable_dir = journal_dir
+        if durable_dir is None and journal_mod.enabled():
+            durable_dir = journal_mod.default_dir()
+        if durable_dir is None and recover_dir is not None:
+            # recovering implies staying durable: the successor journals
+            # into the same directory it replayed from
+            durable_dir = recover_dir
+        replayed = None
+        t0_recover = 0.0
+        if recover_dir is not None:
+            t0_recover = time.perf_counter()
+            state = snapshot_mod.load_state(recover_dir)
+            if state is not None:
+                snapshot_mod.apply_to_service(self, state)
+            replayed = journal_mod.replay(recover_dir)
+        if durable_dir is not None:
+            if snapshot_interval_s is None:
+                raw = os.environ.get(
+                    flag_name("SERVE_SNAPSHOT_INTERVAL_S"), "")
+                snapshot_interval_s = (float(raw) if raw
+                                       else snapshot_mod.DEFAULT_INTERVAL_S)
+            self._journal = journal_mod.RequestJournal(durable_dir)
+            self._snapshots = snapshot_mod.SnapshotWriter(
+                durable_dir, interval_s=float(snapshot_interval_s))
+        if replayed is not None:
+            self._resubmit(replayed, recover_nlp, recover_base_solver,
+                           t0_recover)
+        if self.generation > 1:
+            try:
+                obs_export.set_restart_generation(self.generation)
+            except Exception:
+                pass
+
+    def _resubmit(self, replayed, nlp, base_solver, t0: float) -> None:
+        """Constructor-time recovery: resubmit every request the journal
+        says was QUEUED or DISPATCHED at death.  Deadlines restart their
+        relative budget (the original absolute instant lived on a dead
+        process's clock)."""
+        recovered = 0
+        lost = replayed.lost
+        for rec in replayed.open_requests:
+            if nlp is None:
+                lost += 1
+                continue
+            try:
+                handle = self.submit(
+                    nlp, rec["params"], solver=rec["solver"],
+                    options=rec["options"],
+                    deadline_ms=rec["deadline_ms"],
+                    base_solver=base_solver)
+            except Exception:
+                lost += 1
+                continue
+            self.recovered_handles.append(handle)
+            recovered += 1
+        self.recovery = {
+            "recovered": recovered,
+            "lost": lost,
+            "clean_shutdown": replayed.clean_shutdown,
+            "torn_records": replayed.torn,
+            "recovery_ms": (time.perf_counter() - t0) * 1e3,
+        }
 
     def attach_exporter(self, exporter) -> None:
         """Attach a caller-built :class:`obs.export.ContinuousExporter`
@@ -589,6 +688,15 @@ class SolveService:
                              warm_start=warm)
             bucket.rebuild = (nlp, solver, dict(opts), warm)
             self._buckets[key] = bucket
+            # recovery: a restored snapshot stashed learned state under
+            # this label (the only bucket identity that survives a
+            # process) — apply it before the bucket sees traffic
+            restored = self._restored_buckets.pop(label, None)
+            if restored is not None:
+                try:
+                    snapshot_mod.apply_bucket_state(bucket, restored)
+                except Exception:
+                    pass  # a stale snapshot must never block serving
         # degradation rung 2 (bf16→f32) leaves a redirect on the
         # original bucket: new submissions follow it, in-flight
         # requests finish on the program they were queued for
@@ -624,6 +732,9 @@ class SolveService:
         ``shed_signal``) and fires, the handle completes immediately
         with ``RequestStatus.SHED`` — the request is never queued.
         """
+        if self._draining:
+            raise RuntimeError(
+                "service is draining: submissions are closed")
         now = self._now()
         self.poll(now)
         params = nlp.default_params() if params is None else params
@@ -696,6 +807,13 @@ class SolveService:
             bucket.stats.record_submitted()
             bucket.arrivals.observe(now)
             self._submitted += 1
+        if self._journal is not None:
+            # write-ahead: the accept record (full payload) lands
+            # before any flush below can complete the handle
+            self._journal.accept(
+                handle.request_id, request_fingerprint(params),
+                solver=solver, options=options, deadline_ms=deadline_ms,
+                t=now, params=params)
         self._obs_submitted.inc()
         self._obs_queue_depth.set(float(self._queue_depth()))
         if len(bucket.pending) >= self.options.max_batch:
@@ -773,6 +891,11 @@ class SolveService:
                 n += self._flush_bucket(bucket)
         if self._exporter is not None:
             self._exporter.maybe_export(now)
+        if self._snapshots is not None:
+            try:
+                self._snapshots.maybe_snapshot(self, now)
+            except Exception:
+                pass  # a full disk must not take serving down with it
         return n
 
     def flush_all(self) -> int:
@@ -902,8 +1025,10 @@ class SolveService:
         tracing = obs_trace.enabled()
         label = bucket.stats.label
         live: List[SolveHandle] = []
+        timed_out: List[int] = []
         for r in requests:
             if r.deadline_at is not None and now >= r.deadline_at:
+                timed_out.append(r.request_id)
                 r._complete(ServeResult(
                     RequestStatus.TIMEOUT, None, None,
                     (now - r.submitted_at) * 1e3))
@@ -929,6 +1054,8 @@ class SolveService:
                                 "waited_ms": (now - r.submitted_at) * 1e3})
             else:
                 live.append(r)
+        if self._journal is not None and timed_out:
+            self._journal.status(timed_out, RequestStatus.TIMEOUT)
         if not live:
             return n, None
         dispatch_us = obs_trace.now_us() if tracing else 0.0
@@ -969,6 +1096,9 @@ class SolveService:
             args_s, lanes_s = _stage_subset(sub)
             return args_s, lanes_s, [r.request_id for r in sub]
 
+        if self._journal is not None:
+            self._journal.status([r.request_id for r in live],
+                                 "DISPATCHED")
         faults_armed = _faults.armed()
         try:
             if faults_armed:
@@ -1017,6 +1147,8 @@ class SolveService:
                         end: float, tracing: bool) -> None:
         latency = (end - r.submitted_at) * 1e3
         r._complete(ServeResult(RequestStatus.ERROR, None, None, latency))
+        if self._journal is not None:
+            self._journal.status([r.request_id], RequestStatus.ERROR)
         bucket.stats.record_error()
         self._errors += 1
         self._obs_error.inc()
@@ -1132,6 +1264,7 @@ class SolveService:
             if refined_arr is not None:
                 refined = np.asarray(refined_arr).reshape(-1)
         n_done = 0
+        done_ids: List[int] = []
         for i, r in enumerate(live):
             if i in guilty:
                 # the plan's bisection isolated this lane as guilty:
@@ -1150,6 +1283,7 @@ class SolveService:
                                           if err is not None else None)})
                 continue
             n_done += 1
+            done_ids.append(r.request_id)
             lane = jax.tree_util.tree_map(lambda a, _i=i: a[_i], res)
             latency = (end - r.submitted_at) * 1e3
             r._complete(ServeResult(
@@ -1245,7 +1379,34 @@ class SolveService:
                     bucket.warm_index.add(r.warm_key, r.param_vec,
                                           np.asarray(lane.x),
                                           np.asarray(lane.z))
+        if self._journal is not None and done_ids:
+            self._journal.status(done_ids, RequestStatus.DONE)
         self._obs_solved.inc(n_done)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self) -> Dict:
+        """Graceful shutdown: stop intake, drain every pending request,
+        fence the plan, write a final snapshot, and journal the
+        clean-shutdown marker — a recovery from this directory finds
+        zero open requests (``recovery['clean_shutdown']``).
+
+        Returns ``{"handled", "snapshot"}``.  ``submit`` raises after
+        ``drain`` begins; a second ``drain`` is a cheap no-op."""
+        if self._draining:
+            return {"handled": 0, "snapshot": None}
+        self._draining = True
+        handled = self.flush_all()
+        snapshot_path = None
+        if self._snapshots is not None:
+            try:
+                snapshot_path = self._snapshots.snapshot(self)
+            except Exception:
+                snapshot_path = None
+        if self._journal is not None:
+            self._journal.shutdown(clean=True)
+            self._journal.close()
+        return {"handled": handled, "snapshot": snapshot_path}
 
     # -- telemetry ---------------------------------------------------------
 
@@ -1300,6 +1461,13 @@ class SolveService:
                               if self._submitted else 0.0),
             },
             "warm_start": self._warm_start_metrics(),
+            "durability": {
+                "journaled": self._journal is not None,
+                "snapshot_writes": (0 if self._snapshots is None
+                                    else self._snapshots.writes),
+                "generation": self.generation,
+                "recovery": self.recovery,
+            },
             "buckets": buckets,
             "cost_cards": cost_cards,
         }

@@ -165,6 +165,66 @@ class WarmStartIndex:
         self._cursor = (self._cursor + 1) % self.capacity
         self._count = min(self._count + 1, self.capacity)
 
+    def _logical_order(self) -> list:
+        """Slot indices oldest→newest — the canonical serialization
+        order (a ring's cursor position is an accident of history; the
+        insertion order is not)."""
+        if self._count < self.capacity:
+            return list(range(self._count))
+        return [(self._cursor + i) % self.capacity
+                for i in range(self.capacity)]
+
+    def to_state(self) -> dict:
+        """Serialize to a plain dict of numpy arrays / scalars.
+
+        Entries are emitted in canonical insertion order (oldest
+        first), so serialize → restore → serialize is byte-identical
+        regardless of where the ring's cursor happened to sit, and a
+        restored index answers :meth:`nearest` bitwise-identically
+        (same vectors, same stable ordering, same fixed-order reduce).
+        """
+        order = self._logical_order()
+        return {
+            "capacity": self.capacity,
+            "k": self.k,
+            "radius": self.radius,
+            "scale": None if self._scale is None else
+                np.array(self._scale, np.float64),
+            "vecs": None if self._vecs is None else
+                np.array(self._vecs[order], np.float64),
+            "keys": [self._keys[s] for s in order],
+            "xs": [np.asarray(self._sols[s][0]) for s in order],
+            "zs": [np.asarray(self._sols[s][1]) for s in order],
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "WarmStartIndex":
+        """Rebuild an index from :meth:`to_state` output.  Entries land
+        in slots 0..count-1 (canonical layout) with the cursor after
+        the newest — the logical ring is identical to the source's."""
+        idx = cls(capacity=int(state["capacity"]), k=int(state["k"]),
+                  radius=float(state["radius"]))
+        vecs = state.get("vecs")
+        if vecs is None:
+            return idx
+        vecs = np.asarray(vecs, np.float64)
+        count = vecs.shape[0]
+        idx._vecs = np.zeros((idx.capacity, vecs.shape[1]), np.float64)
+        idx._vecs[:count] = vecs
+        idx._scale = np.asarray(state["scale"], np.float64)
+        for slot in range(count):
+            key = state["keys"][slot]
+            if isinstance(key, list):
+                key = tuple(key)
+            idx._sols[slot] = (np.asarray(state["xs"][slot]),
+                               np.asarray(state["zs"][slot]))
+            idx._keys[slot] = key
+            if key is not None:
+                idx._slot_of[key] = slot
+        idx._count = count
+        idx._cursor = count % idx.capacity
+        return idx
+
     def exact(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Exact-fingerprint lookup: the newest solution recorded under
         ``key``, or None."""

@@ -61,6 +61,11 @@ def test_preview_record_passes_schema(bench):
         assert key in out["chaos"]
     for key in bench.CHAOS_NONNULL_KEYS:
         assert out["chaos"][key] is not None
+    # the durable-restart replay (r15): headline metrics measured
+    for key in bench.CRASH_RESTART_KEYS:
+        assert key in out["crash_restart"]
+    for key in bench.CRASH_RESTART_NONNULL_KEYS:
+        assert out["crash_restart"][key] is not None
     # the adaptive-scheduler A/B (r12, ISSUE 14)
     for key in bench.SCHED_KEYS:
         assert key in out["scheduler"]
@@ -348,6 +353,18 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["chaos"]
     bench.validate_bench_output(out)
+    # crash_restart (r15): optional-but-complete, headline non-null
+    out = json.load(open(PREVIEW))
+    del out["crash_restart"]["lost_request_rate"]
+    with pytest.raises(ValueError, match="lost_request_rate"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["crash_restart"]["restart_recovery_ms"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["crash_restart"]
+    bench.validate_bench_output(out)
     # scheduler (r12): optional-but-complete, both arms carry the full
     # per-arm key set
     out = json.load(open(PREVIEW))
@@ -386,6 +403,28 @@ def test_preview_chaos_section(bench):
     assert chaos["p99_ratio_chaos_vs_baseline"] == pytest.approx(
         chaos["soak_p99_ms"] / chaos["baseline_p99_ms"], abs=5e-5)
     assert chaos["p99_ratio_chaos_vs_baseline"] < 2.0
+
+
+def test_preview_crash_restart_section(bench):
+    """The r15 durable-restart section backs the durability
+    acceptance: with the write-ahead journal + snapshots armed, a
+    mid-replay kill (service and plan dropped with no drain, wedged
+    fences firing under the watchdog) lost zero accepted requests,
+    left zero hung handles, and the snapshot-restored warm-start index
+    kept the post-crash hit rate within 10% of the pre-crash
+    service."""
+    out = json.load(open(PREVIEW))
+    cr = out["crash_restart"]
+    assert cr["n_requests"] > 0
+    assert cr["hung"] == 0
+    assert cr["open_at_crash"] > 0  # the kill caught requests mid-air
+    assert cr["recovered"] == cr["open_at_crash"]
+    assert cr["lost"] == 0
+    assert cr["lost_request_rate"] == 0.0
+    assert 0.0 < cr["restart_recovery_ms"] < 10_000.0
+    assert cr["requests_done"] <= cr["n_requests"]
+    assert (cr["warm_hit_rate_post"]
+            >= cr["warm_hit_rate_pre"] - 0.1)
 
 
 def test_bench_record_round_trips_through_ledger(bench, tmp_path):

@@ -1,0 +1,378 @@
+"""Durable serve (docs/robustness.md · Durability): the write-ahead
+request journal, learned-state snapshots, crash recovery, and the
+fence watchdog.
+
+Coverage, all on stub kernels and fake/real-but-instant clocks
+(tier-1 cheap):
+
+* the journal codec round-trips a params pytree **bitwise** (the
+  resubmitted fingerprint equals the journaled one);
+* ``replay`` reconstructs exactly the open set — terminal statuses
+  close a fingerprint, duplicate accepts dedupe, a torn trailing
+  record is skipped and counted, and replaying twice is idempotent;
+* a clean ``drain()`` marker empties the replay (nothing to recover
+  from an orderly exit) and closes the service to new submissions;
+* ``SolveService(recover_dir=...)`` resubmits every request open at
+  death and completes it — zero lost, generation bumped when a
+  snapshot was on disk — and a second recovery finds nothing;
+* the disarmed hot path is **spy-pinned**: without a journal directory
+  the service never constructs a ``RequestJournal`` at all;
+* ``WarmStartIndex.to_state``/``from_state`` round-trips through the
+  journal codec with ``nearest()`` answering bitwise-identically;
+* the fence watchdog escapes a wedged fence as
+  ``PlanError(kind="hang")`` into the retry domain (result correct,
+  ``faults.hung`` counted, ``faults.injected`` untouched) and emits a
+  ``plan_hang`` flight bundle when the recorder is armed;
+* the soak harness's crash-restart scenario loses nothing;
+* flight-recorder eviction is bounded and counted
+  (``flight.evicted``), and ``metrics.prom`` carries the
+  restart-generation-labeled ``process_start_us`` gauge.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.faults import inject as faults
+from dispatches_tpu.obs import export as obs_export
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import registry as reg
+from dispatches_tpu.obs.soak import (FakeClock, StubNLP, make_stub_solver,
+                                     run_soak)
+from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+from dispatches_tpu.serve import (RequestStatus, ServeOptions, SolveService,
+                                  journal, snapshot, warmstart)
+from dispatches_tpu.serve.bucket import request_fingerprint
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    """Every test starts and ends disarmed, with the durability env
+    flags unset (a developer's armed shell must not leak in)."""
+    monkeypatch.delenv("DISPATCHES_TPU_SERVE_JOURNAL_DIR", raising=False)
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def stub_nlp():
+    return StubNLP()
+
+
+@pytest.fixture(scope="module")
+def stub_solver():
+    return make_stub_solver()
+
+
+def _new_service(**kw):
+    plan = ExecutionPlan(PlanOptions(inflight=2))
+    return SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                     warm_start=False, plan=plan), **kw)
+
+
+def _params(nlp, i):
+    p = nlp.default_params()
+    p["p"]["price"] = p["p"]["price"] * (1.0 + 0.01 * i)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# journal codec + replay
+# ---------------------------------------------------------------------------
+
+
+def test_journal_codec_round_trips_params_bitwise():
+    params = {
+        "p": {"price": np.linspace(0.0, 1.0, 24),
+              "cf": np.random.default_rng(0).random(24).astype(np.float32)},
+        "fixed": {"cap": 25.0, "n": 3, "flag": True, "name": "pem"},
+        "tup": (np.arange(4, dtype=np.int64), 2.5),
+        "none": None,
+    }
+    decoded = journal.decode_tree(
+        json.loads(json.dumps(journal.encode_tree(params))))
+    assert isinstance(decoded["tup"], tuple)
+    np.testing.assert_array_equal(decoded["p"]["price"],
+                                  params["p"]["price"])
+    assert decoded["p"]["cf"].dtype == np.float32
+    # the durability contract: the fingerprint of what recovery
+    # resubmits equals the fingerprint the journal recorded
+    assert request_fingerprint(decoded) == request_fingerprint(params)
+
+
+def test_journal_replay_open_set_torn_tail_and_idempotence(tmp_path):
+    d = str(tmp_path)
+    j = journal.RequestJournal(d, segment_records=4)  # forces rotation
+    for i in (1, 2, 3, 4, 5):
+        j.accept(i, f"fp-{i}", solver="pdlp", options=None,
+                 deadline_ms=50.0 if i == 1 else None, t=float(i),
+                 params={"x": np.array([float(i)])})
+    # a duplicate accept for fp-4 (a previous recovery's re-accept):
+    # replay must collapse it to one open request
+    j.accept(6, "fp-4", solver="pdlp", options=None, deadline_ms=None,
+             t=6.0, params={"x": np.array([4.0])})
+    j.status([1, 2], "DISPATCHED")
+    j.status([2], "DONE")
+    j.status([3], "TIMEOUT")
+    j.close()  # no clean marker — this journal "crashed"
+    assert len([n for n in os.listdir(d)
+                if n.startswith("journal-")]) > 1  # rotation happened
+    # a crash mid-write tears the final line
+    segs = sorted(n for n in os.listdir(d) if n.startswith("journal-"))
+    with open(os.path.join(d, segs[-1]), "a", encoding="utf-8") as fh:
+        fh.write('{"k":"a","id":9,"fp":"fp-9"')
+
+    rep = journal.replay(d)
+    assert rep.torn == 1
+    assert not rep.clean_shutdown
+    assert rep.accepted == 6
+    open_fps = [r["fp"] for r in rep.open_requests]
+    assert open_fps == ["fp-1", "fp-4", "fp-5"]  # 2 DONE, 3 TIMEOUT
+    assert rep.open_requests[0]["deadline_ms"] == 50.0
+    np.testing.assert_array_equal(rep.open_requests[1]["params"]["x"],
+                                  [4.0])
+    # replaying the same journal twice reconstructs the same set
+    rep2 = journal.replay(d)
+    assert [r["fp"] for r in rep2.open_requests] == open_fps
+
+
+def test_journal_clean_shutdown_empties_replay(tmp_path):
+    j = journal.RequestJournal(str(tmp_path))
+    j.accept(1, "fp-1", solver="pdlp", options=None, deadline_ms=None,
+             t=0.0, params={"x": np.array([1.0])})
+    j.shutdown(clean=True)
+    j.close()
+    rep = journal.replay(str(tmp_path))
+    assert rep.clean_shutdown
+    assert rep.open_requests == []
+    # post-close writes are silent no-ops, not crashes
+    j.accept(2, "fp-2", solver="pdlp", options=None, deadline_ms=None,
+             t=1.0, params={})
+
+
+# ---------------------------------------------------------------------------
+# warm-start index state round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_warm_index_state_round_trip_nearest_bitwise():
+    rng = np.random.default_rng(3)
+    idx = warmstart.WarmStartIndex(capacity=6, k=3, radius=0.5)
+    base = rng.random(8) + 1.0
+    for i in range(8):  # wraps the ring: two oldest evicted
+        vec = base * (1.0 + 0.03 * rng.standard_normal(8))
+        idx.add(f"k{i}", vec, rng.standard_normal(8),
+                rng.standard_normal(3))
+    # state survives the journal codec (how snapshots persist it)
+    state = journal.decode_tree(json.loads(json.dumps(
+        journal.encode_tree(idx.to_state()))))
+    idx2 = warmstart.WarmStartIndex.from_state(state)
+    assert len(idx2) == len(idx) == 6
+    # serialize → restore → serialize is canonical (byte-identical)
+    assert json.dumps(journal.encode_tree(idx2.to_state())) == \
+        json.dumps(journal.encode_tree(idx.to_state()))
+    for _ in range(5):
+        probe = base * (1.0 + 0.03 * rng.standard_normal(8))
+        a, b = idx.nearest(probe), idx2.nearest(probe)
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        assert float(a[2]) == float(b[2])  # bitwise, not approx
+
+
+# ---------------------------------------------------------------------------
+# service crash recovery
+# ---------------------------------------------------------------------------
+
+
+def test_service_crash_recovery_completes_open_requests(tmp_path, stub_nlp,
+                                                        stub_solver):
+    d = str(tmp_path)
+    svc1 = _new_service(journal_dir=d, snapshot_interval_s=1e-6)
+    done = [svc1.submit(stub_nlp, _params(stub_nlp, i), solver="pdlp",
+                        base_solver=stub_solver) for i in range(3)]
+    svc1.flush_all()
+    assert all(h.result().status == RequestStatus.DONE for h in done)
+    svc1.poll()  # first maybe_snapshot always writes
+    assert os.path.exists(os.path.join(d, snapshot.SNAPSHOT_FILE))
+    # two more requests are accepted but never dispatched — then the
+    # process "dies" (no drain; the object is simply dropped)
+    lost = [svc1.submit(stub_nlp, _params(stub_nlp, 10 + i), solver="pdlp",
+                        base_solver=stub_solver) for i in range(2)]
+    del svc1, lost
+
+    svc2 = _new_service(recover_dir=d, recover_nlp=stub_nlp,
+                        recover_base_solver=stub_solver,
+                        snapshot_interval_s=1e-6)
+    rec = svc2.recovery
+    assert rec["recovered"] == 2 and rec["lost"] == 0
+    assert not rec["clean_shutdown"]
+    assert rec["recovery_ms"] >= 0.0
+    assert svc2.generation == 2  # the snapshot carried generation 1
+    assert len(svc2.recovered_handles) == 2
+    svc2.flush_all()
+    assert all(h.result().status == RequestStatus.DONE
+               for h in svc2.recovered_handles)
+    dur = svc2.metrics()["durability"]
+    assert dur["journaled"] and dur["generation"] == 2
+    assert dur["recovery"]["recovered"] == 2
+
+    # an orderly exit leaves nothing for a third process to recover
+    svc2.drain()
+    svc3 = _new_service(recover_dir=d, recover_nlp=stub_nlp,
+                        recover_base_solver=stub_solver,
+                        snapshot_interval_s=1e-6)
+    assert svc3.recovery["recovered"] == 0
+    assert svc3.recovery["clean_shutdown"]
+    assert svc3.recovered_handles == []
+
+
+def test_drain_closes_submissions_and_is_idempotent(tmp_path, stub_nlp,
+                                                    stub_solver):
+    svc = _new_service(journal_dir=str(tmp_path), snapshot_interval_s=1e-6)
+    h = svc.submit(stub_nlp, _params(stub_nlp, 0), solver="pdlp",
+                   base_solver=stub_solver)
+    out = svc.drain()
+    assert h.result().status == RequestStatus.DONE
+    assert out["snapshot"] is not None
+    with pytest.raises(RuntimeError, match="draining"):
+        svc.submit(stub_nlp, _params(stub_nlp, 1), solver="pdlp",
+                   base_solver=stub_solver)
+    svc.drain()  # second drain is a no-op, not an error
+    assert journal.replay(str(tmp_path)).clean_shutdown
+
+
+def test_disarmed_service_never_touches_the_journal(monkeypatch, stub_nlp,
+                                                    stub_solver):
+    def _boom(*a, **k):
+        raise AssertionError("RequestJournal constructed while disarmed")
+
+    monkeypatch.setattr(journal.RequestJournal, "__init__", _boom)
+    svc = _new_service()  # no journal_dir, env flag cleared by fixture
+    hs = [svc.submit(stub_nlp, _params(stub_nlp, i), solver="pdlp",
+                     base_solver=stub_solver) for i in range(3)]
+    svc.flush_all()
+    assert all(h.result().status == RequestStatus.DONE for h in hs)
+    dur = svc.metrics()["durability"]
+    assert not dur["journaled"] and dur["recovery"] is None
+
+
+# ---------------------------------------------------------------------------
+# fence watchdog
+# ---------------------------------------------------------------------------
+
+
+def _hang_plan(clk, timeout_ms=40.0):
+    plan = ExecutionPlan(PlanOptions(inflight=2, donate=False,
+                                     fence_timeout_ms=timeout_ms),
+                         clock=clk)
+    prog = plan.program(lambda a: a * 2.0, label="durability.toy",
+                        vmap_axes=0)
+    return plan, prog
+
+
+def _submit_with_restage(plan, prog, vals):
+    import jax.numpy as jnp
+
+    arr = np.asarray(vals, np.float64)
+
+    def _restage(idxs):
+        rows = arr[list(idxs)]
+        staged = plan.stage(jnp.asarray(rows), lanes=rows.shape[0],
+                            donate=False)
+        return (staged,), rows.shape[0], None
+
+    staged = plan.stage(jnp.asarray(arr), lanes=arr.shape[0], donate=False)
+    return plan.submit(prog, (staged,), n_live=arr.shape[0],
+                       lanes=arr.shape[0], restage=_restage)
+
+
+def test_fence_watchdog_escapes_hang_into_retry_domain():
+    clk = FakeClock()
+    plan, prog = _hang_plan(clk, timeout_ms=40.0)
+    faults.arm("plan.fence,hang_s=10,times=1")
+    hung0, inj0 = faults.hung_total(), faults.injected_total()
+    ret0 = reg.counter("plan.retries").total()
+    ticket = _submit_with_restage(plan, prog, [1.0, 2.0, 3.0])
+    res = np.asarray(plan.collect(ticket))
+    np.testing.assert_allclose(res, [2.0, 4.0, 6.0])
+    # the hang was escaped and retried — nobody waited the 10 s out
+    assert ticket.error is not None and ticket.error.kind == "hang"
+    assert ticket.error.guilty == ()
+    assert faults.hung_total() - hung0 == 1
+    assert reg.counter("plan.retries").total() - ret0 >= 1
+    # a hang is not an "injected" fault: fault_recovery_rate is about
+    # raising faults, and a wedge must not inflate it
+    assert faults.injected_total() - inj0 == 0
+    # the watchdog consumed only its budget from the virtual clock
+    assert clk() == pytest.approx(0.04)
+
+
+def test_hang_escape_emits_plan_hang_flight_bundle(monkeypatch, tmp_path):
+    monkeypatch.setenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+    clk = FakeClock()
+    plan, prog = _hang_plan(clk, timeout_ms=25.0)
+    faults.arm("plan.fence,hang_s=5,times=1")
+    ticket = _submit_with_restage(plan, prog, [1.0, 2.0])
+    plan.collect(ticket)
+    paths = [n for n in os.listdir(str(tmp_path))
+             if n.startswith("flight-") and n.endswith(".json")]
+    assert paths, "hang escape must leave a flight bundle"
+    bundle = obs_flight.load_bundle(os.path.join(str(tmp_path),
+                                                 sorted(paths)[0]))
+    assert bundle["kind"] == "plan_hang"
+    assert bundle["trigger"]["detail"]["fence_timeout_ms"] == 25.0
+
+
+# ---------------------------------------------------------------------------
+# soak crash-restart, flight eviction, restart gauge
+# ---------------------------------------------------------------------------
+
+
+def test_soak_crash_restart_loses_nothing():
+    rep = run_soak({
+        "traffic": {"duration_s": 1.0, "rate_rps": 60.0, "seed": 23},
+        "restart": {"enabled": True, "crash_at_s": 0.5,
+                    "snapshot_interval_s": 0.25},
+    })
+    req = rep["requests"]
+    rs = rep["restart"]
+    assert req["hung"] == 0
+    assert rs["lost"] == 0 and rep["lost_request_rate"] == 0.0
+    assert rs["recovered"] == rs["open_at_crash"]
+    assert rs["generation"] == 2
+    assert rep["restart_recovery_ms"] > 0.0
+
+
+def test_flight_eviction_is_bounded_and_counted(tmp_path):
+    for i in range(5):
+        with open(os.path.join(str(tmp_path), f"flight-{i:05d}.json"),
+                  "w") as fh:
+            fh.write("{}")
+    ev0 = reg.counter("flight.evicted").total()
+    obs_flight._prune(str(tmp_path), keep=2)
+    left = sorted(n for n in os.listdir(str(tmp_path)))
+    assert left == ["flight-00003.json", "flight-00004.json"]
+    assert reg.counter("flight.evicted").total() - ev0 == 3
+
+
+def test_metrics_prom_carries_generation_labeled_start_gauge(tmp_path):
+    prev = obs_export.set_restart_generation(7)
+    try:
+        exp = obs_export.ContinuousExporter(
+            obs_export.ExportOptions(directory=str(tmp_path)),
+            clock=FakeClock())
+        exp.export()
+        text = open(os.path.join(str(tmp_path),
+                                 obs_export.PROM_FILE)).read()
+        assert 'dispatches_tpu_process_start_us{generation="7"} ' in text
+        assert text.count("# TYPE dispatches_tpu_process_start_us gauge") \
+            == 1
+    finally:
+        obs_export.set_restart_generation(prev)
