@@ -1,16 +1,22 @@
 """Static analysis + runtime sanitizers for JAX discipline.
 
-Two halves share this package:
+Three halves share this package:
 
-* ``graftlint`` — an AST pass (rules GL001-GL006) catching the patterns
+* ``graftlint`` — an AST pass (rules GL001-GL008) catching the patterns
   that silently destroy the port's lower-once property: host calls on
   tracers, Python branches on traced values, bad static_argnums, jnp
   construction in per-hour host loops, unguarded float64 casts, and
   unregistered ``DISPATCHES_TPU_*`` flags.  Run it with
   ``python -m dispatches_tpu.analysis --check``.
+* ``lockcheck`` — a second AST pass (rules GL009-GL012) enforcing the
+  serve/plan lock discipline: no device/disk waits or reentrant sinks
+  under a held lock, a cycle-free global acquisition-order graph, and
+  consistently guarded fields.  Same CLI, same baseline.
 * ``runtime`` — ``graft_jit`` (jax.jit with recompile accounting +
-  ``assert_no_recompiles()`` for steady-state tests) and ``nan_guard``
-  (opt-in NaN/Inf checks behind ``DISPATCHES_TPU_SANITIZE``).
+  ``assert_no_recompiles()`` for steady-state tests), ``nan_guard``
+  (opt-in NaN/Inf checks behind ``DISPATCHES_TPU_SANITIZE``), and
+  ``sanitized_lock`` (the lock-order sanitizer behind the same flag —
+  GL011's runtime counterpart).
 """
 
 from dispatches_tpu.analysis.flags import (  # noqa: F401
@@ -28,16 +34,26 @@ from dispatches_tpu.analysis.graftlint import (  # noqa: F401
     new_findings,
     write_baseline,
 )
+from dispatches_tpu.analysis.lockcheck import (  # noqa: F401
+    LOCKCHECK_RULES,
+    check_paths,
+    check_source,
+)
 from dispatches_tpu.analysis.runtime import (  # noqa: F401
+    LockOrderError,
     RecompileWarning,
     SanitizeWarning,
+    SanitizedLock,
     assert_no_recompiles,
     checkified,
     drain_sanitize_events,
     graft_jit,
+    lock_order_report,
     nan_guard,
     recompile_counts,
+    reset_lock_order,
     reset_recompile_counts,
     sanitize_enabled,
+    sanitized_lock,
 )
 from dispatches_tpu.analysis.selftest import CORPUS, run_selftest  # noqa: F401

@@ -78,6 +78,13 @@ RULES: Dict[str, str] = {
     "GL006": "unregistered-env-flag",
     "GL007": "unfenced-timing",
     "GL008": "dispatch-outside-plan",
+    # GL009-GL012 are the lock-discipline pass (analysis/lockcheck.py);
+    # they share this registry so findings render, fingerprint, and
+    # baseline identically to the device-discipline rules above.
+    "GL009": "blocking-under-lock",
+    "GL010": "reentrant-sink-under-lock",
+    "GL011": "lock-order-inversion",
+    "GL012": "guarded-field-unguarded-write",
 }
 
 DEFAULT_BASELINE = Path(__file__).with_name("graftlint.baseline")

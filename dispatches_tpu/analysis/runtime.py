@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import sys
 import threading
+import time
 import warnings
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -32,6 +34,8 @@ from dispatches_tpu.analysis.flags import flag_enabled
 __all__ = [
     "RecompileWarning",
     "SanitizeWarning",
+    "LockOrderError",
+    "SanitizedLock",
     "graft_jit",
     "recompile_counts",
     "reset_recompile_counts",
@@ -40,11 +44,19 @@ __all__ = [
     "nan_guard",
     "drain_sanitize_events",
     "checkified",
+    "sanitized_lock",
+    "lock_order_report",
+    "reset_lock_order",
 ]
 
 
 class RecompileWarning(UserWarning):
     """A graft_jit-wrapped callable was traced more than once."""
+
+
+class LockOrderError(RuntimeError):
+    """A SanitizedLock observed a lock-order inversion (or a
+    non-reentrant self re-acquire) at runtime."""
 
 
 class SanitizeWarning(UserWarning):
@@ -290,3 +302,200 @@ def checkified(fun: Callable, errors: Optional[frozenset] = None) -> Callable:
         return out
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# lock-order sanitizer (DISPATCHES_TPU_SANITIZE)
+# ---------------------------------------------------------------------------
+#
+# The runtime half of the GL011 static rule: the linter proves the
+# acquisition-order graph of *lexically visible* acquisitions is
+# acyclic, this sanitizer watches the orders that actually happen —
+# including ones threaded through callbacks and dynamic dispatch the
+# one-level summaries cannot see.  ``sanitized_lock(name)`` is the
+# factory the concurrent layers use for their guards:
+#
+#   - disarmed (flag unset at CONSTRUCTION time): returns a genuine
+#     ``threading.Lock``/``RLock`` — not a wrapper, the exact object
+#     type, so the hot path pays literally zero (spy-pinned in tests
+#     by type identity);
+#   - armed: returns a wrapper that records per-thread acquisition
+#     stacks and per-site hold durations, registers every observed
+#     held->acquired edge in a process-wide order graph, and raises
+#     :class:`LockOrderError` the moment an acquisition inverts an
+#     edge already observed in the other direction (or a thread
+#     re-enters a non-reentrant lock).
+#
+# ``lock_order_report()`` feeds the ``sanitize.lock_order`` dump the CI
+# smoke asserts empty on the clean tree.
+
+_ORDER_LOCK = threading.Lock()  # guards the three dicts below
+_ORDER_EDGES: Dict[Tuple[str, str], str] = {}   # (held, acquired) -> site
+_ORDER_INVERSIONS: List[Dict[str, str]] = []
+_HOLD_SITES: Dict[str, Dict[str, float]] = {}   # "name@file:line" -> stats
+_HELD = threading.local()  # per-thread stack of live _Acquisition
+
+
+class _Acquisition:
+    __slots__ = ("name", "site", "t0")
+
+    def __init__(self, name: str, site: str, t0: float):
+        self.name = name
+        self.site = site
+        self.t0 = t0
+
+
+def _held_stack() -> List["_Acquisition"]:
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    return stack
+
+
+def _call_site(depth: int) -> str:
+    frame = sys._getframe(depth)
+    return f"{frame.f_code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno}"
+
+
+class _SanitizedLock:
+    """The armed wrapper: a context-manager lock with order tracking.
+
+    Not a drop-in for every ``threading`` API (no ``Condition``
+    integration) — it covers ``with``/``acquire``/``release``/
+    ``locked``, which is all the concurrent layers use.
+    """
+
+    __slots__ = ("name", "reentrant", "_inner")
+
+    def __init__(self, name: str, reentrant: bool):
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    # -- order bookkeeping -------------------------------------------------
+
+    def _before_acquire(self, site: str) -> None:
+        stack = _held_stack()
+        held_names = []
+        for acq in stack:
+            if acq.name == self.name:
+                if not self.reentrant:
+                    with _ORDER_LOCK:
+                        _ORDER_INVERSIONS.append({
+                            "kind": "self-deadlock", "lock": self.name,
+                            "site": site, "prior_site": acq.site})
+                    raise LockOrderError(
+                        f"non-reentrant lock '{self.name}' re-acquired "
+                        f"at {site} while held (acquired at "
+                        f"{acq.site}) — this thread would deadlock")
+                return  # re-entering: no new order edges
+            if acq.name not in held_names:
+                held_names.append(acq.name)
+        if not held_names:
+            return
+        with _ORDER_LOCK:
+            for held in held_names:
+                reverse = _ORDER_EDGES.get((self.name, held))
+                if reverse is not None:
+                    _ORDER_INVERSIONS.append({
+                        "kind": "inversion", "first": held,
+                        "second": self.name, "site": site,
+                        "reverse_site": reverse})
+                    raise LockOrderError(
+                        f"lock-order inversion: '{held}' -> "
+                        f"'{self.name}' at {site}, but "
+                        f"'{self.name}' -> '{held}' was observed at "
+                        f"{reverse} — two threads taking the pair in "
+                        "opposite orders deadlock")
+                _ORDER_EDGES.setdefault((held, self.name), site)
+
+    def _after_acquire(self, site: str) -> None:
+        _held_stack().append(_Acquisition(self.name, site,
+                                          time.perf_counter()))
+
+    def _on_release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].name == self.name:
+                acq = stack.pop(i)
+                held_s = time.perf_counter() - acq.t0
+                key = f"{self.name}@{acq.site}"
+                with _ORDER_LOCK:
+                    stats = _HOLD_SITES.setdefault(
+                        key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                    stats["count"] += 1
+                    stats["total_s"] += held_s
+                    stats["max_s"] = max(stats["max_s"], held_s)
+                return
+
+    # -- lock API ----------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        site = _call_site(2)
+        self._before_acquire(site)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._after_acquire(site)
+        return got
+
+    def release(self) -> None:
+        self._on_release()
+        self._inner.release()
+
+    def __enter__(self) -> "_SanitizedLock":
+        site = _call_site(2)
+        self._before_acquire(site)
+        self._inner.acquire()
+        self._after_acquire(site)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    def __repr__(self) -> str:
+        return (f"<SanitizedLock {self.name!r} "
+                f"{'reentrant' if self.reentrant else 'plain'}>")
+
+
+#: public alias for isinstance checks / docs
+SanitizedLock = _SanitizedLock
+
+
+def sanitized_lock(name: str, *, reentrant: bool = True):
+    """A lock for the concurrent layers' guards: the plain
+    ``threading`` lock when ``DISPATCHES_TPU_SANITIZE`` is unset (zero
+    overhead, by type identity), the order-tracking
+    :class:`SanitizedLock` when armed.
+
+    The flag is read at CONSTRUCTION time — like ``nan_guard``'s
+    trace-time rule: arm the sanitizer before building the service or
+    plan whose locks you want watched.
+    """
+    if not flag_enabled("SANITIZE"):
+        return threading.RLock() if reentrant else threading.Lock()
+    return _SanitizedLock(name, reentrant)
+
+
+def lock_order_report() -> Dict[str, object]:
+    """The ``sanitize.lock_order`` report: every acquisition-order edge
+    observed, every inversion raised, and per-site hold durations."""
+    with _ORDER_LOCK:
+        return {
+            "edges": {f"{a} -> {b}": site
+                      for (a, b), site in sorted(_ORDER_EDGES.items())},
+            "inversions": [dict(i) for i in _ORDER_INVERSIONS],
+            "holds": {k: dict(v) for k, v in sorted(_HOLD_SITES.items())},
+        }
+
+
+def reset_lock_order() -> None:
+    """Clear the process-wide order graph, inversion log, and hold
+    stats (per-thread held stacks clear themselves on release)."""
+    with _ORDER_LOCK:
+        _ORDER_EDGES.clear()
+        _ORDER_INVERSIONS.clear()
+        _HOLD_SITES.clear()

@@ -13,6 +13,7 @@ from textwrap import dedent
 from typing import Dict, List
 
 from dispatches_tpu.analysis.graftlint import RULES, lint_source
+from dispatches_tpu.analysis.lockcheck import LOCKCHECK_RULES, check_source
 
 CORPUS: Dict[str, Dict[str, str]] = {
     "GL001": {
@@ -208,6 +209,142 @@ CORPUS: Dict[str, Dict[str, str]] = {
                 return plan.collect(ticket), warm
         """,
     },
+    # -- lock-discipline rules (routed through lockcheck.check_source) --
+    "GL009": {
+        "bad": """
+            import threading
+            import time
+
+            class Window:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tickets = []
+
+                def retire(self, ticket):
+                    with self._lock:
+                        time.sleep(0.05)
+                        self._tickets.remove(ticket)
+        """,
+        "good": """
+            import threading
+            import time
+
+            class Window:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._tickets = []
+
+                def retire(self, ticket):
+                    time.sleep(0.05)
+                    with self._lock:
+                        self._tickets.remove(ticket)
+        """,
+    },
+    "GL010": {
+        "bad": """
+            import threading
+
+            class Service:
+                def __init__(self, flight):
+                    self._lock = threading.Lock()
+                    self._flight = flight
+                    self._done = []
+
+                def complete(self, handle):
+                    with self._lock:
+                        self._done.append(handle)
+                        self._flight.trigger("serve.complete")
+        """,
+        "good": """
+            import threading
+
+            class Service:
+                def __init__(self, flight):
+                    self._lock = threading.Lock()
+                    self._flight = flight
+                    self._done = []
+
+                def complete(self, handle):
+                    with self._lock:
+                        self._done.append(handle)
+                    self._flight.trigger("serve.complete")
+        """,
+    },
+    "GL011": {
+        "bad": """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.rows = []
+                    self.sums = []
+
+                def append(self, row):
+                    with self._a:
+                        with self._b:
+                            self.rows.append(row)
+
+                def total(self):
+                    with self._b:
+                        with self._a:
+                            self.sums.append(len(self.rows))
+        """,
+        "good": """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self.rows = []
+                    self.sums = []
+
+                def append(self, row):
+                    with self._a:
+                        with self._b:
+                            self.rows.append(row)
+
+                def total(self):
+                    with self._a:
+                        with self._b:
+                            self.sums.append(len(self.rows))
+        """,
+    },
+    "GL012": {
+        "bad": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.solved = 0
+
+                def record(self):
+                    with self._lock:
+                        self.solved += 1
+
+                def reset(self):
+                    self.solved = 0
+        """,
+        "good": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.solved = 0
+
+                def record(self):
+                    with self._lock:
+                        self.solved += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.solved = 0
+        """,
+    },
 }
 
 
@@ -221,13 +358,15 @@ def run_selftest() -> List[str]:
         if snippets is None:
             errors.append(f"{rule}: no self-test snippet in CORPUS")
             continue
-        bad = lint_source(dedent(snippets["bad"]), f"<{rule}-bad>")
+        # lock-discipline rules live in the second pass
+        check = check_source if rule in LOCKCHECK_RULES else lint_source
+        bad = check(dedent(snippets["bad"]), f"<{rule}-bad>")
         if not any(f.rule == rule for f in bad):
             errors.append(
                 f"{rule}: did not fire on its bad snippet "
                 f"(got {[f.rule for f in bad]})"
             )
-        good = lint_source(dedent(snippets["good"]), f"<{rule}-good>")
+        good = check(dedent(snippets["good"]), f"<{rule}-good>")
         hits = [f for f in good if f.rule == rule]
         if hits:
             errors.append(

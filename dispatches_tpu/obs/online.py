@@ -38,6 +38,7 @@ hot path's completion callbacks.
 from __future__ import annotations
 
 import heapq
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
@@ -493,6 +494,12 @@ class TimelineAccumulator:
         self.plan = plan
         self._gauges = gauges
         self._registry = registry
+        # ingest is a trace sink, and spans retire concurrently: the
+        # plan emits plan.submit outside its window lock (so parallel
+        # submitters don't serialize on telemetry), which makes this
+        # accumulator's heap + counters multi-writer.  One short
+        # host-side lock keeps the sweep consistent.
+        self._lock = threading.Lock()
         self._edges: List[Tuple[float, int, int]] = []  # (t, step, kind)
         self._depth_h = 0
         self._depth_i = 0
@@ -513,7 +520,8 @@ class TimelineAccumulator:
     def ingest(self, event: Dict) -> None:
         """Consume one trace event (Chrome-shaped dict); non-plan
         events and foreign plan ids are ignored, so this is safe as a
-        blanket ``trace.add_sink``."""
+        blanket ``trace.add_sink`` — including from concurrently
+        retiring spans (thread-safe)."""
         if event.get("ph") != "X":
             return
         name = event.get("name")
@@ -523,28 +531,30 @@ class TimelineAccumulator:
         pid = args.get("plan")
         if pid is None:
             return
-        if self.plan is None:
-            self.plan = pid
-        elif pid != self.plan:
-            return
-        ts = float(event["ts"])
-        end = ts + float(event.get("dur", 0.0))
-        if name == "plan.fence":
-            self._fence_bound_us += end - ts
-            heapq.heappush(self._edges, (end, -1, _INFLIGHT))
-        else:
-            # t_lo matches build_timeline: stage/submit starts only
-            if self._t_lo is None or ts < self._t_lo:
-                self._t_lo = ts
-            heapq.heappush(self._edges, (ts, +1, _HOST))
-            heapq.heappush(self._edges, (end, -1, _HOST))
-            if name == "plan.submit":
-                self.n_batches += 1
-                heapq.heappush(self._edges, (end, +1, _INFLIGHT))
-        if self._t_hi is None or end > self._t_hi:
-            self._t_hi = end
-        self._advance(end)
-        if name == "plan.fence" and self._gauges:
+        with self._lock:
+            if self.plan is None:
+                self.plan = pid
+            elif pid != self.plan:
+                return
+            ts = float(event["ts"])
+            end = ts + float(event.get("dur", 0.0))
+            if name == "plan.fence":
+                self._fence_bound_us += end - ts
+                heapq.heappush(self._edges, (end, -1, _INFLIGHT))
+            else:
+                # t_lo matches build_timeline: stage/submit starts only
+                if self._t_lo is None or ts < self._t_lo:
+                    self._t_lo = ts
+                heapq.heappush(self._edges, (ts, +1, _HOST))
+                heapq.heappush(self._edges, (end, -1, _HOST))
+                if name == "plan.submit":
+                    self.n_batches += 1
+                    heapq.heappush(self._edges, (end, +1, _INFLIGHT))
+            if self._t_hi is None or end > self._t_hi:
+                self._t_hi = end
+            self._advance(end)
+            publish = name == "plan.fence" and self._gauges
+        if publish:
             self._publish()
 
     def _advance(self, watermark: float) -> None:

@@ -100,7 +100,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
-from dispatches_tpu.analysis.runtime import graft_jit
+from dispatches_tpu.analysis.runtime import graft_jit, sanitized_lock
 from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
@@ -405,12 +405,12 @@ class ExecutionPlan:
         # window + exactly-once fence bookkeeping must not race.  The
         # expensive parts — host staging, the device wait, recovery,
         # on_done — all stay outside it.
-        self._lock = threading.RLock()
+        self._lock = sanitized_lock("plan.window", reentrant=True)
         # fence order guard: one fence (pop + wait + recovery +
         # on_done) retires at a time, so fence-order annotations and
         # on_done callbacks are serialized.  Reentrant: an on_done that
         # re-submits may have to fence the window overflow itself.
-        self._fence_lock = threading.RLock()
+        self._fence_lock = sanitized_lock("plan.fence", reentrant=True)
         self._ctrl = None
         if self.options.inflight_max is not None:
             from dispatches_tpu.plan.adaptive import InflightDepthController
@@ -623,45 +623,54 @@ class ExecutionPlan:
         tracing = obs_trace.enabled()
         ctrl = self._ctrl
         stamp = tracing or ctrl is not None
+        # solver dispatch runs OUTSIDE the window lock (GL009): JAX
+        # dispatch is async but still costs host microseconds-to-
+        # milliseconds, and a second submitter (or a collector probing
+        # the window) must not wait on it.  The ticket is private until
+        # appended, so the unlocked mutation is safe.
+        ticket = PlanTicket(program.label, lanes, n_live, on_done,
+                            request_ids=request_ids)
+        ticket._program = program
+        ticket._restage = restage
+        ticket._t_dispatch_us = obs_trace.now_us() if stamp else 0.0
+        try:
+            if _faults.armed():
+                _faults.check("plan.submit", label=program.label,
+                              request_ids=request_ids)
+                _faults.check("solver", label=program.label,
+                              request_ids=request_ids)
+            ticket._raw = program._run(*args)
+        except Exception as exc:  # noqa: BLE001 — recovery at fence
+            ticket._exc = exc
+        end_us = obs_trace.now_us() if stamp else 0.0
         with self._lock:
-            ticket = PlanTicket(program.label, lanes, n_live, on_done,
-                                seq=next(self._seq),
-                                request_ids=request_ids)
-            ticket._program = program
-            ticket._restage = restage
-            ticket._t_dispatch_us = obs_trace.now_us() if stamp else 0.0
-            try:
-                if _faults.armed():
-                    _faults.check("plan.submit", label=program.label,
-                                  request_ids=request_ids)
-                    _faults.check("solver", label=program.label,
-                                  request_ids=request_ids)
-                ticket._raw = program._run(*args)
-            except Exception as exc:  # noqa: BLE001 — recovery at fence
-                ticket._exc = exc
+            # seq is assigned with the append, under the same lock, so
+            # window order IS seq order — the invariant FIFO fencing
+            # and the fence-order annotation both lean on
+            ticket.seq = next(self._seq)
             self._window.append(ticket)
-            if stamp:
-                # host dispatch cost only: _run returned, nothing fenced
-                end_us = obs_trace.now_us()
-                args_kw = dict(plan=self.plan_id, seq=ticket.seq,
-                               label=ticket.label, lanes=lanes,
-                               live=n_live, inflight=len(self._window))
-                if request_ids is not None:
-                    args_kw["request_ids"] = list(request_ids)
-                if tracing:
-                    obs_trace.complete("plan.submit",
-                                       ticket._t_dispatch_us,
-                                       end_us - ticket._t_dispatch_us,
-                                       **args_kw)
-                if ctrl is not None:
-                    ctrl.ingest({
-                        "name": "plan.submit", "ph": "X",
-                        "ts": ticket._t_dispatch_us,
-                        "dur": end_us - ticket._t_dispatch_us,
-                        "args": args_kw})
-            self._obs_batches.inc(label=program.label)
-            self._labels.add(program.label)
-            self._gauge.set(float(len(self._window)))
+            inflight = len(self._window)
+        if stamp:
+            # host dispatch cost only: _run returned, nothing fenced
+            args_kw = dict(plan=self.plan_id, seq=ticket.seq,
+                           label=ticket.label, lanes=lanes,
+                           live=n_live, inflight=inflight)
+            if request_ids is not None:
+                args_kw["request_ids"] = list(request_ids)
+            if tracing:
+                obs_trace.complete("plan.submit",
+                                   ticket._t_dispatch_us,
+                                   end_us - ticket._t_dispatch_us,
+                                   **args_kw)
+            if ctrl is not None:
+                ctrl.ingest({
+                    "name": "plan.submit", "ph": "X",
+                    "ts": ticket._t_dispatch_us,
+                    "dur": end_us - ticket._t_dispatch_us,
+                    "args": args_kw})
+        self._obs_batches.inc(label=program.label)
+        self._labels.add(program.label)
+        self._gauge.set(float(inflight))
         # fence window overflow OUTSIDE the dispatch lock: the device
         # wait (+ recovery + on_done) must never serialize submitters
         self._trim_window()
@@ -739,7 +748,10 @@ class ExecutionPlan:
         when the window is empty.  The fence lock serializes retiring
         fencers — on_done callbacks and fence-order annotations stay
         ordered — while submitters only ever need the window lock."""
-        with self._fence_lock:
+        # the fence lock holds across the device wait + on_done BY
+        # DESIGN: only fencers contend on it (submitters take just the
+        # window lock), and serializing retirement is the whole point
+        with self._fence_lock:  # lockcheck: intentional
             with self._lock:
                 if not self._window:
                     return None

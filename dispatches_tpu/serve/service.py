@@ -96,6 +96,7 @@ import jax
 import numpy as np
 
 from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.analysis.runtime import sanitized_lock
 from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.obs import export as obs_export
 from dispatches_tpu.obs import flight as obs_flight
@@ -518,7 +519,7 @@ class SolveService:
                          PlanOptions.from_env(mesh=self.options.mesh)))
         # guards queue mutation only — host-side staging (warm-start
         # cast, stacking, host→device transfer) runs outside it
-        self._lock = threading.RLock()
+        self._lock = sanitized_lock("serve.service", reentrant=True)
         self._buckets: Dict = {}
         self._latency = LatencyWindow(self.options.latency_window)
         self._queue_wait = QueueWaitWindow(self.options.latency_window)
@@ -802,21 +803,31 @@ class SolveService:
                 else:
                     self._warm_misses += 1
                     handle.start = bucket.warm_cold_start
+        if self._journal is not None:
+            # write-ahead: the accept record (full payload) must be
+            # durable BEFORE the handle enters the queue — once it is
+            # in ``bucket.pending``, a concurrent flush can dispatch
+            # and complete it, and a completed request with no accept
+            # record breaks the crash-recovery contract (replay would
+            # never know it existed)
+            self._journal.accept(
+                handle.request_id, request_fingerprint(params),
+                solver=solver, options=options, deadline_ms=deadline_ms,
+                t=now, params=params)
         with self._lock:
             bucket.pending.append(handle)
             bucket.stats.record_submitted()
             bucket.arrivals.observe(now)
             self._submitted += 1
-        if self._journal is not None:
-            # write-ahead: the accept record (full payload) lands
-            # before any flush below can complete the handle
-            self._journal.accept(
-                handle.request_id, request_fingerprint(params),
-                solver=solver, options=options, deadline_ms=deadline_ms,
-                t=now, params=params)
+            # snapshot the flush decision and the exported depth under
+            # the same lock that appended: a racing flush between the
+            # append and an unlocked re-read could double-dispatch the
+            # bucket or export a stale depth
+            should_flush = len(bucket.pending) >= self.options.max_batch
+            depth = self._queue_depth()
         self._obs_submitted.inc()
-        self._obs_queue_depth.set(float(self._queue_depth()))
-        if len(bucket.pending) >= self.options.max_batch:
+        self._obs_queue_depth.set(float(depth))
+        if should_flush:
             self._flush_bucket(bucket)
         if self._exporter is not None:
             self._exporter.maybe_export(self._clock())

@@ -31,6 +31,7 @@ Coverage, all on stub kernels and fake/real-but-instant clocks
 
 import json
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -246,6 +247,63 @@ def test_drain_closes_submissions_and_is_idempotent(tmp_path, stub_nlp,
                    base_solver=stub_solver)
     svc.drain()  # second drain is a no-op, not an error
     assert journal.replay(str(tmp_path)).clean_shutdown
+
+
+def test_write_ahead_accept_precedes_completion_under_concurrent_flush(
+        tmp_path, stub_nlp, stub_solver):
+    """The PR 16 ordering race, pinned: ``journal.accept`` must be
+    durable BEFORE the handle enters ``bucket.pending``.  A flusher
+    thread races ``submit`` the whole time — if the append ever lands
+    first, a request can dispatch and reach a terminal status with no
+    accept record ahead of it in the journal stream, which is exactly
+    what replay-based crash recovery cannot survive."""
+    d = str(tmp_path)
+    svc = _new_service(journal_dir=d)
+    stop = threading.Event()
+    flush_errors = []
+
+    def flusher():
+        try:
+            while not stop.is_set():
+                svc.flush_all()
+        except Exception as exc:  # pragma: no cover - the failure mode
+            flush_errors.append(exc)
+
+    t = threading.Thread(target=flusher)
+    t.start()
+    try:
+        handles = [svc.submit(stub_nlp, _params(stub_nlp, i),
+                              solver="pdlp", base_solver=stub_solver)
+                   for i in range(32)]
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert not t.is_alive() and not flush_errors
+    svc.flush_all()
+    assert all(h.result().status == RequestStatus.DONE for h in handles)
+
+    # stream-order invariant: walking the segments in write order,
+    # every id carrying a terminal status has an accept record EARLIER
+    # in the stream (write-ahead, not write-behind)
+    accepted_ids = set()
+    terminal_before_accept = []
+    segs = sorted(n for n in os.listdir(d) if n.startswith("journal-"))
+    for seg in segs:
+        with open(os.path.join(d, seg), encoding="utf-8") as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec["k"] == "a":
+                    accepted_ids.add(rec["id"])
+                elif rec["k"] == "s" and rec["st"] in \
+                        journal.TERMINAL_STATUSES:
+                    terminal_before_accept.extend(
+                        i for i in rec["ids"] if i not in accepted_ids)
+    assert terminal_before_accept == []
+    assert len(accepted_ids) == 32
+    # and replay agrees: every request completed, nothing left open
+    rep = journal.replay(d)
+    assert rep.accepted == 32
+    assert rep.open_requests == []
 
 
 def test_disarmed_service_never_touches_the_journal(monkeypatch, stub_nlp,

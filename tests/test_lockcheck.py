@@ -1,0 +1,523 @@
+"""Lock-discipline pass (GL009-GL012) + runtime lock-order sanitizer.
+
+Three layers under test:
+
+* ``analysis.lockcheck`` — the AST pass: per-class/module lock model,
+  held-lock tracking through ``with`` nesting, one-level call
+  summaries, the ``# lockcheck: intentional`` pragma, and the global
+  acquisition-order graph.
+* ``analysis.runtime.sanitized_lock`` — disarmed it IS the plain
+  ``threading`` lock (type identity — zero wrapper overhead, the same
+  spy-pin style as the journal/flight gates); armed it raises
+  :class:`LockOrderError` on an observed inversion.
+* the CLI — ``--json`` machine-readable findings with the same
+  exit-code contract as the text report.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from dispatches_tpu.analysis import (
+    LOCKCHECK_RULES,
+    LockOrderError,
+    RULES,
+    SanitizedLock,
+    check_source,
+    lock_order_report,
+    reset_lock_order,
+    sanitized_lock,
+)
+from dispatches_tpu.analysis.lockcheck import check_paths
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _check(src: str, relpath: str = "pkg/mod.py"):
+    return check_source(textwrap.dedent(src), relpath)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rule mechanics beyond the selftest corpus
+# ---------------------------------------------------------------------------
+
+
+def test_lockcheck_rules_are_registered():
+    """GL009-GL012 render through the shared RULES registry."""
+    for rule in LOCKCHECK_RULES:
+        assert rule in RULES
+    f = _check("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """)[0]
+    assert "blocking-under-lock" in f.render()
+    assert f.line == 11
+
+
+def test_gl009_module_level_lock():
+    findings = _check("""
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def tick():
+            with _lock:
+                time.sleep(0.1)
+    """)
+    assert _rules(findings) == ["GL009"]
+
+
+def test_gl009_zero_arg_result_blocks_with_args_does_not():
+    bad = _check("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, fut):
+                with self._lock:
+                    return fut.result()
+    """)
+    assert _rules(bad) == ["GL009"]
+    good = _check("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, builder):
+                with self._lock:
+                    return builder.result("label", 3)
+    """)
+    assert good == []
+
+
+def test_gl009_one_level_call_summary():
+    """`self._flush()` under the lock is caught when _flush fences."""
+    findings = _check("""
+        import threading
+        import jax
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._batch = None
+
+            def _flush(self):
+                return jax.block_until_ready(self._batch)
+
+            def f(self):
+                with self._lock:
+                    self._flush()
+    """)
+    assert _rules(findings) == ["GL009"]
+    assert "_flush" in findings[0].message
+
+
+def test_gl010_trace_emission_under_lock():
+    findings = _check("""
+        import threading
+        from dispatches_tpu.obs import trace as obs_trace
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, t0, dur):
+                with self._lock:
+                    obs_trace.complete("span", t0, dur)
+    """)
+    assert _rules(findings) == ["GL010"]
+
+
+def test_gl010_nested_function_is_not_under_the_lock():
+    """A callback DEFINED under a with runs later — no finding."""
+    findings = _check("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cb = None
+
+            def f(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1)
+                    self._cb = later
+    """)
+    assert findings == []
+
+
+def test_pragma_suppresses_gl009_gl010_only():
+    src = """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:  # lockcheck: intentional
+                    time.sleep(1)
+    """
+    assert _check(src) == []
+    # the pragma is scoped to the annotated hold, not the file
+    findings = _check(src + """
+        class V:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def g(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    assert _rules(findings) == ["GL009"]
+
+
+def test_pragma_rule_scoped():
+    """`intentional(GL009)` leaves GL010 armed on the same hold."""
+    findings = _check("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self, flight):
+                self._lock = threading.Lock()
+                self._flight = flight
+
+            def f(self):
+                with self._lock:  # lockcheck: intentional(GL009)
+                    time.sleep(1)
+                    self._flight.trigger("x")
+    """)
+    assert _rules(findings) == ["GL010"]
+
+
+def test_gl011_self_deadlock_on_plain_lock_not_rlock():
+    plain = _check("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        self.n += 1
+    """)
+    assert "GL011" in _rules(plain)
+    rlock = _check("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        self.n += 1
+    """)
+    assert rlock == []
+
+
+def test_gl011_cross_file_graph(tmp_path):
+    """An inversion split across two modules only a global graph sees."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(textwrap.dedent("""
+        import threading
+
+        red = threading.Lock()
+        blue = threading.Lock()
+
+        def forward():
+            with red:
+                with blue:
+                    pass
+    """))
+    b.write_text(textwrap.dedent("""
+        from a import red, blue
+
+        def backward():
+            with blue:
+                with red:
+                    pass
+    """))
+    findings = check_paths([tmp_path])
+    assert "GL011" in _rules(findings)
+    # per-file checks see no cycle
+    assert "GL011" not in _rules(check_source(a.read_text(), "a.py"))
+
+
+def test_gl012_init_writes_exempt():
+    """The selftest good snippet writes bare in __init__ — allowed."""
+    findings = _check("""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.solved = 0
+                self.errors = 0
+
+            def record(self):
+                with self._lock:
+                    self.solved += 1
+                    self.errors += 1
+    """)
+    assert findings == []
+
+
+def test_gl012_fires_per_bare_write_site():
+    findings = _check("""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.solved = 0
+
+            def record(self):
+                with self._lock:
+                    self.solved += 1
+
+            def reset(self):
+                self.solved = 0
+
+            def force(self, n):
+                self.solved = n
+    """)
+    assert _rules(findings) == ["GL012", "GL012"]
+
+
+def test_repo_tree_is_lockcheck_clean():
+    """The serve/plan fixes landed: the pass reports nothing on the
+    package (the fence-lock hold is pragma'd, not baselined)."""
+    from dispatches_tpu.analysis.graftlint import package_root
+
+    assert check_paths([package_root()]) == []
+
+
+# ---------------------------------------------------------------------------
+# sanitized_lock: disarmed spy-pin + armed order tracking
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_sanitized_lock_is_the_plain_lock(monkeypatch):
+    monkeypatch.delenv("DISPATCHES_TPU_SANITIZE", raising=False)
+    r = sanitized_lock("t.r", reentrant=True)
+    p = sanitized_lock("t.p", reentrant=False)
+    # type identity, not isinstance: the disarmed path must return the
+    # exact threading object — no wrapper, no per-acquire bookkeeping
+    assert type(r) is type(threading.RLock())
+    assert type(p) is type(threading.Lock())
+
+
+def test_armed_sanitized_lock_wraps(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_SANITIZE", "1")
+    lock = sanitized_lock("t.armed", reentrant=True)
+    assert isinstance(lock, SanitizedLock)
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_SANITIZE", "1")
+    reset_lock_order()
+    yield
+    reset_lock_order()
+
+
+def test_armed_detects_inverted_acquisition(armed):
+    a = sanitized_lock("inv.a")
+    b = sanitized_lock("inv.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderError, match="inversion"):
+            with a:
+                pass
+    report = lock_order_report()
+    assert "inv.a -> inv.b" in report["edges"]
+    assert any(i["kind"] == "inversion" for i in report["inversions"])
+
+
+def test_armed_consistent_order_is_quiet_and_reports_holds(armed):
+    a = sanitized_lock("ord.a")
+    b = sanitized_lock("ord.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    report = lock_order_report()
+    assert report["inversions"] == []
+    assert "ord.a -> ord.b" in report["edges"]
+    holds = [k for k in report["holds"] if k.startswith("ord.a@")]
+    assert holds and report["holds"][holds[0]]["count"] == 3
+
+
+def test_armed_reentrant_reacquire_ok_plain_raises(armed):
+    r = sanitized_lock("re.r", reentrant=True)
+    with r:
+        with r:
+            pass  # RLock semantics preserved
+    p = sanitized_lock("re.p", reentrant=False)
+    with p:
+        with pytest.raises(LockOrderError, match="re-acquired"):
+            with p:
+                pass
+    # the sanitizer raised BEFORE deadlocking: the lock is released
+    # by the outer with and acquirable again
+    with p:
+        pass
+
+
+def test_armed_inversion_observed_across_threads(armed):
+    """The order graph is process-wide: thread 1 establishes a->b,
+    thread 2's b->a attempt raises (the real deadlock geometry)."""
+    a = sanitized_lock("thr.a")
+    b = sanitized_lock("thr.b")
+
+    with a:
+        with b:
+            pass
+
+    caught = []
+
+    def other():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockOrderError as exc:
+            caught.append(exc)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(caught) == 1
+
+
+def test_armed_service_and_plan_locks_are_sanitized(armed):
+    """Construction-time arming reaches the real serve/plan guards,
+    and a full submit→drain cycle observes no inversions."""
+    from dispatches_tpu.obs.soak import StubNLP, make_stub_solver
+    from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+    from dispatches_tpu.serve import (RequestStatus, ServeOptions,
+                                      SolveService)
+
+    plan = ExecutionPlan(PlanOptions(inflight=2))
+    svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                    warm_start=False, plan=plan))
+    assert isinstance(svc._lock, SanitizedLock)
+    assert isinstance(plan._lock, SanitizedLock)
+    assert isinstance(plan._fence_lock, SanitizedLock)
+    nlp = StubNLP()
+    h = svc.submit(nlp, nlp.default_params(), solver="pdlp",
+                   base_solver=make_stub_solver())
+    svc.drain()
+    assert h.result().status == RequestStatus.DONE
+    report = lock_order_report()
+    assert report["inversions"] == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json contract
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "dispatches_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_cli_json_clean_tree_exits_zero():
+    proc = _run_cli("--check", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == 1
+    assert doc["counts"]["new"] == 0
+    assert doc["counts"]["total"] == len(doc["findings"])
+    assert all(f["baselined"] for f in doc["findings"])
+
+
+def test_cli_json_seeded_violations_exit_nonzero(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self, flight):
+                self._lock = threading.Lock()
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._flight = flight
+                self.n = 0
+
+            def gl009(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def gl010(self):
+                with self._lock:
+                    self._flight.trigger("x")
+
+            def gl011_fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def gl011_bwd(self):
+                with self._b:
+                    with self._a:
+                        pass
+
+            def gl012_guarded(self):
+                with self._lock:
+                    self.n += 1
+
+            def gl012_bare(self):
+                self.n = 0
+    """))
+    proc = _run_cli("--check", "--json", str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    fired = {f["rule"] for f in doc["findings"]}
+    assert {"GL009", "GL010", "GL011", "GL012"} <= fired
+    assert doc["counts"]["new"] == len(doc["findings"])
+    assert all(not f["baselined"] for f in doc["findings"])
+    for f in doc["findings"]:
+        assert f["name"] == RULES[f["rule"]]
+        assert f["path"] and f["line"] > 0 and f["message"]
+        assert isinstance(f["fingerprint"], str) and f["fingerprint"]
