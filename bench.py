@@ -356,6 +356,23 @@ CRASH_RESTART_KEYS = ("n_requests", "requests_done", "open_at_crash",
                       "warm_hit_rate_post", "hung")
 CRASH_RESTART_NONNULL_KEYS = ("lost_request_rate", "restart_recovery_ms")
 
+#: the fleet A/B (ISSUE 17): the same virtual stub replay through the
+#: fleet router at 1 replica and at 3, plus a third arm that kills one
+#: of the 3 mid-replay.  ``fleet_scaling_efficiency`` is
+#: throughput(3) / (3 x throughput(1)) on identical request streams
+#: (gated, higher is better — the replication tax) and
+#: ``replica_lost_request_rate`` is the fraction of accepted requests
+#: the kill arm failed to drive to a terminal status after journal
+#: handoff (gated, lower is better — the fleet no-hang contract is
+#: exactly 0).  ``hung`` is the kill arm's count and must be 0.
+FLEET_KEYS = ("n_requests", "n_replicas", "solves_per_sec_1",
+              "solves_per_sec_3", "fleet_scaling_efficiency",
+              "kill_at_s", "failovers", "rehomed",
+              "replica_lost_request_rate", "hung",
+              "requests_done_kill")
+FLEET_NONNULL_KEYS = ("fleet_scaling_efficiency",
+                      "replica_lost_request_rate")
+
 
 def validate_bench_output(out):
     """Raise ValueError when ``out`` breaks the single-line contract;
@@ -473,6 +490,16 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench crash_restart headline metrics must be "
                 f"measured, not null: {nulls}")
+    fleet = out.get("fleet")
+    if fleet is not None:
+        missing = [k for k in FLEET_KEYS if k not in fleet]
+        if missing:
+            raise ValueError(f"bench fleet missing sub-keys: {missing}")
+        nulls = [k for k in FLEET_NONNULL_KEYS if fleet.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench fleet headline metrics must be measured, "
+                f"not null: {nulls}")
     return out
 
 
@@ -546,6 +573,17 @@ def _finalize_output(out):
             metrics["restart_recovery_ms"] = cr["restart_recovery_ms"]
         if cr.get("lost_request_rate") is not None:
             metrics["lost_request_rate"] = cr["lost_request_rate"]
+        # fleet section: scaling efficiency is gated (higher is better
+        # — the replication tax must not creep) and the kill arm's
+        # lost-request fraction is gated (lower is better; the fleet
+        # handoff contract is exactly 0)
+        fleet = out.get("fleet") or {}
+        if fleet.get("fleet_scaling_efficiency") is not None:
+            metrics["fleet_scaling_efficiency"] = \
+                fleet["fleet_scaling_efficiency"]
+        if fleet.get("replica_lost_request_rate") is not None:
+            metrics["replica_lost_request_rate"] = \
+                fleet["replica_lost_request_rate"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -1508,6 +1546,63 @@ def run_bench():
             }
     except Exception as exc:
         out["crash_restart_bench_error"] = str(exc)[:120]
+
+    # ---- fleet A/B (ISSUE 17): the same virtual stub replay through
+    # the fleet router at 1 replica and at 3 on identical request
+    # streams, plus a kill-one arm over the 3-replica fleet (heartbeat
+    # detection -> journal handoff -> re-home).  The per-lane-dominated
+    # service-time regime keeps total device-busy proportional to work
+    # so scaling efficiency measures routing + batching overhead, not
+    # batch fragmentation.  fleet_scaling_efficiency and
+    # replica_lost_request_rate feed the gated ledger ----------------
+    try:
+        if time.monotonic() < deadline:
+            from dispatches_tpu.obs import soak as obs_soak
+
+            fleet_base = {
+                "traffic": {"process": "poisson", "rate_rps": 600.0,
+                            "duration_s": 2.0, "seed": 7,
+                            "perturb": ["price"], "rho": 0.9,
+                            "sigma": 0.05},
+                "service": {"max_batch": 8, "max_wait_ms": 40.0,
+                            "inflight": 2},
+                "service_time": {"base_ms": 2.0, "per_lane_ms": 30.0,
+                                 "jitter_ms": 1.0},
+            }
+            fleet_kill_at_s = 1.2
+
+            def _fleet_arm(n_replicas, kill=None):
+                spec = {k: dict(v) for k, v in fleet_base.items()}
+                spec["fleet"] = {"enabled": True,
+                                 "n_replicas": n_replicas,
+                                 "kill": kill or [],
+                                 "heartbeat_timeout_ms": 250.0,
+                                 "gossip_interval_s": 1.0}
+                return obs_soak.run_soak(spec)
+
+            fl1 = _fleet_arm(1)
+            fl3 = _fleet_arm(3)
+            flk = _fleet_arm(3, kill=[[0, fleet_kill_at_s]])
+            tp1 = fl1["requests"]["done"] / fl1["duration_s"]
+            tp3 = fl3["requests"]["done"] / fl3["duration_s"]
+            flf = flk["fleet"]
+            out["fleet"] = {
+                "n_requests": fl1["requests"]["submitted"],
+                "n_replicas": 3,
+                "solves_per_sec_1": round(tp1, 2),
+                "solves_per_sec_3": round(tp3, 2),
+                "fleet_scaling_efficiency": (
+                    round(tp3 / (3 * tp1), 4) if tp1 else None),
+                "kill_at_s": fleet_kill_at_s,
+                "failovers": flf["failovers"],
+                "rehomed": flf["rehomed"],
+                "replica_lost_request_rate": flf[
+                    "replica_lost_request_rate"],
+                "hung": flk["requests"]["hung"],
+                "requests_done_kill": flk["requests"]["done"],
+            }
+    except Exception as exc:
+        out["fleet_bench_error"] = str(exc)[:120]
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------

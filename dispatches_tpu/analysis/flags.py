@@ -161,6 +161,18 @@ REGISTERED_FLAGS = {
     "raises PlanError(kind='hang') into the retry/bisection domain "
     "and shrinks the in-flight window "
     "(plan.PlanOptions.from_env; unset = unbounded fences)",
+    "FLEET_REPLICAS": "fleet-serve replica count behind the "
+    "FleetRouter façade; 1 (the default) is a pass-through that "
+    "constructs no gossip/heartbeat machinery "
+    "(fleet.FleetOptions.from_env)",
+    "FLEET_HEARTBEAT_MS": "fleet-serve heartbeat timeout on the "
+    "router clock: a replica whose last beat is older is declared "
+    "dead and failed over (journal replay + re-home onto survivors) "
+    "(fleet.FleetOptions.from_env; default 500)",
+    "FLEET_GOSSIP_INTERVAL_S": "fleet-serve seconds between gossip "
+    "rounds exchanging warm-start index entries and admission "
+    "service-time estimates between replicas "
+    "(fleet.FleetOptions.from_env; default 5)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -185,6 +185,9 @@ CORPUS: Dict[str, Dict[str, str]] = {
             jdir = os.environ.get("DISPATCHES_TPU_SERVE_JOURNAL_DIR")
             snap = os.environ.get("DISPATCHES_TPU_SERVE_SNAPSHOT_INTERVAL_S")
             fence = os.environ.get("DISPATCHES_TPU_PLAN_FENCE_TIMEOUT_MS")
+            freps = os.environ.get("DISPATCHES_TPU_FLEET_REPLICAS")
+            fhb = os.environ.get("DISPATCHES_TPU_FLEET_HEARTBEAT_MS")
+            fgos = os.environ.get("DISPATCHES_TPU_FLEET_GOSSIP_INTERVAL_S")
         """,
     },
     "GL008": {

@@ -18,6 +18,13 @@ site                    where it fires
                         batch staging before the plan is involved
 ``service.clock``       non-raising: skews the service's view of "now"
                         (deadline triage, queue-wait) by ``skew_s``
+``replica.heartbeat``   :meth:`fleet.ReplicaHandle.heartbeat` — the beat
+                        is silently lost (contained), so a persistent
+                        rule drives heartbeat-timeout failover of a
+                        live replica (label = replica name)
+``router.submit``       :meth:`fleet.FleetRouter.submit` — the request
+                        is refused at the fleet façade and completes
+                        as ``SHED`` (contained)
 ======================  ====================================================
 
 A **scenario** is a list of rules.  The string grammar (also accepted
@@ -111,6 +118,8 @@ SITES = (
     "solver",
     "serve.stage",
     "service.clock",
+    "replica.heartbeat",
+    "router.submit",
 )
 
 _UNLIMITED = None  # sentinel for "no fire budget"

@@ -125,6 +125,13 @@ GATED_METRICS = {
     # from the write-ahead journal's replay path
     "restart_recovery_ms": -1,
     "lost_request_rate": -1,
+    # bench fleet section (ISSUE 17): throughput(3 replicas) over
+    # 3 x throughput(1) on identical streams — the replication tax —
+    # and the kill arm's fraction of accepted requests that never
+    # reached a terminal status after journal handoff; the fleet
+    # no-hang contract is exactly zero
+    "fleet_scaling_efficiency": +1,
+    "replica_lost_request_rate": -1,
 }
 
 _GIT_SHA: Optional[str] = None

@@ -139,6 +139,51 @@ def _soak_plan(options, clock: FakeClock, service_time_s):
     return _SoakPlan(options, clock=clock)
 
 
+class _ReplicaClock:
+    """Per-replica view of the shared :class:`FakeClock` plus a
+    transient ``lead``, applied only while a fence completes so the
+    replica's latency/deadline accounting sees the batch's modeled
+    finish instant.  Replicas each have their own busy timeline, so a
+    fleet soak models genuine overlap — advancing the one global clock
+    per batch would serialize the replicas and cap measured scaling at
+    1/n no matter how well the router spread the load."""
+
+    __slots__ = ("base", "lead")
+
+    def __init__(self, base: FakeClock):
+        self.base = base
+        self.lead = 0.0
+
+    def __call__(self) -> float:
+        return self.base() + self.lead
+
+
+def _fleet_plan(options, global_clock: FakeClock,
+                replica_clock: _ReplicaClock, service_time_s, state: Dict):
+    """The fleet-mode counterpart of :func:`_soak_plan`: the fence does
+    NOT advance the global clock.  Each batch starts when the replica
+    is free (``max(now, busy_until)``), finishes ``service_time`` later,
+    and the replica clock *leads* to that finish instant only while the
+    completion bookkeeping runs — the global clock stays on the arrival
+    schedule, and the driver accounts the busy tails at the end."""
+    from dispatches_tpu.plan.execution import ExecutionPlan
+
+    class _FleetSoakPlan(ExecutionPlan):
+        def _complete_oldest(self):
+            if not self._window:
+                return super()._complete_oldest()
+            start = max(global_clock(), state["busy_until"])
+            finish = start + service_time_s(self._window[0])
+            state["busy_until"] = finish
+            replica_clock.lead = max(finish - global_clock(), 0.0)
+            try:
+                return super()._complete_oldest()
+            finally:
+                replica_clock.lead = 0.0
+
+    return _FleetSoakPlan(options, clock=replica_clock)
+
+
 # ---------------------------------------------------------------------------
 # minimal-compile stub workload
 # ---------------------------------------------------------------------------
@@ -262,6 +307,18 @@ DEFAULT_SPEC: Dict = {
     # learned-state snapshot) and keep replaying.  Virtual mode only.
     "restart": {"enabled": False, "crash_at_s": None,
                 "snapshot_interval_s": 1.0},
+    # fleet (docs/fleet.md): replay against a FleetRouter over
+    # n_replicas SolveServices instead of a bare service.  ``enabled``
+    # None = auto (fleet when n_replicas > 1); True forces the fleet
+    # path even at n_replicas == 1 (the bench A/B baseline, so both
+    # arms share the routing/plan mechanics); False never.  ``kill`` is
+    # a list of [replica_id, at_s] fail-stop windows (virtual seconds
+    # from t0) — detection and failover run on the heartbeat timeout,
+    # per-replica journals re-home the open requests onto survivors.
+    # Virtual mode only; mutually exclusive with ``restart``.
+    "fleet": {"enabled": None, "n_replicas": 1, "kill": [],
+              "heartbeat_timeout_ms": 250.0, "gossip_interval_s": 1.0,
+              "shed_queue_depth": None},
 }
 
 
@@ -401,6 +458,21 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                        else _tempfile.mkdtemp(prefix="soak-durable-"))
     snap_interval = float(restart_cfg.get("snapshot_interval_s") or 1.0)
 
+    # fleet tier (docs/fleet.md): n replicas behind a FleetRouter
+    fleet_cfg = spec.get("fleet") or {}
+    n_replicas = int(fleet_cfg.get("n_replicas") or 1)
+    _fleet_flag = fleet_cfg.get("enabled")
+    fleet_mode = (bool(_fleet_flag) if _fleet_flag is not None
+                  else n_replicas > 1)
+    if fleet_mode and not virtual:
+        raise ValueError("the fleet soak section is virtual-only (the "
+                         "per-replica busy timelines live on the fake "
+                         "clock)")
+    if fleet_mode and restart_enabled:
+        raise ValueError("fleet and restart soak sections are mutually "
+                         "exclusive: fleet failover IS the restart "
+                         "story (journal handoff instead of rebuild)")
+
     def _serve_options(p):
         return ServeOptions(
             max_batch=int(svc_cfg["max_batch"]),
@@ -410,10 +482,44 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                               else int(shed_depth)),
             adaptive_wait=bool(svc_cfg.get("adaptive_wait", False)))
 
-    plan = _new_plan()
-    service = SolveService(
-        _serve_options(plan), clock=clk, journal_dir=durable_dir,
-        snapshot_interval_s=(snap_interval if durable_dir else None))
+    router = None
+    replica_busy: Dict[int, Dict] = {}
+    if fleet_mode:
+        import os as _os
+
+        from dispatches_tpu.fleet import FleetOptions, FleetRouter
+
+        def _make_replica(replica_id, journal_dir):
+            rclk = _ReplicaClock(clk)
+            state = {"busy_until": 0.0}
+            replica_busy[replica_id] = state
+            plan = _fleet_plan(plan_opts, clk, rclk, model.sampler(clk),
+                               state)
+            return SolveService(_serve_options(plan), clock=rclk,
+                                journal_dir=journal_dir,
+                                snapshot_interval_s=(
+                                    snap_interval if journal_dir
+                                    else None))
+
+        fleet_shed = fleet_cfg.get("shed_queue_depth")
+        router = FleetRouter(
+            FleetOptions(
+                n_replicas=n_replicas,
+                heartbeat_timeout_ms=float(
+                    fleet_cfg.get("heartbeat_timeout_ms") or 250.0),
+                gossip_interval_s=float(
+                    fleet_cfg.get("gossip_interval_s") or 1.0),
+                shed_queue_depth=(None if fleet_shed is None
+                                  else int(fleet_shed))),
+            clock=clk, make_service=_make_replica,
+            durable_dir=(_os.path.join(str(out_dir), "fleet-durable")
+                         if out_dir and n_replicas > 1 else None))
+        service = router
+    else:
+        plan = _new_plan()
+        service = SolveService(
+            _serve_options(plan), clock=clk, journal_dir=durable_dir,
+            snapshot_interval_s=(snap_interval if durable_dir else None))
 
     # pre-compile the lane-count programs before any instrument is
     # attached: warmup latency is compile latency, not tail signal
@@ -444,9 +550,12 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
     if fault_cfg.get("shed_on_burn"):
         # sustained-burn load shedding: any monitor rule firing sheds
         # new submissions until its windows drain back under threshold
+        # (the router exposes the same shed_signal contract)
         service.shed_signal = lambda: any(m.firing for m in monitors)
 
-    acc = online.TimelineAccumulator(plan=service.plan.plan_id)
+    acc_plan_id = (service.plan.plan_id if router is None
+                   else router.replicas[0].service.plan.plan_id)
+    acc = online.TimelineAccumulator(plan=acc_plan_id)
     latencies: List[float] = []
     alerts: List[Dict] = []
     bundle_paths: List[str] = []
@@ -469,32 +578,47 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
             ExportOptions(directory=str(out_dir),
                           interval_s=float(spec["export_interval_s"])),
             clock=clk)
-        service.attach_exporter(exporter)
+        if router is None:
+            service.attach_exporter(exporter)
+        # fleet mode ticks the exporter from the driver loop instead:
+        # attaching to one replica would stop exporting when it dies
 
     # latency/queue-wait tee: the service's window ``record`` calls
     # happen exactly at fence/dispatch time, so shadowing them on the
-    # instance is the zero-copy streaming feed (restored in finally)
-    orig_lat = service._latency.record
-    orig_qw = service._queue_wait.record
+    # instance is the zero-copy streaming feed (restored in finally).
+    # Fleet mode tees every replica; observations land on the shared
+    # stream with global-clock timestamps either way.
+    tees: List[Tuple[object, Callable, Callable]] = []
 
-    def _lat_record(label: str, ms: float) -> None:
-        now = clk()
-        latencies.append(float(ms))
-        lat_stream.observe(ms)
-        lat_drift.observe(ms)
-        for m in lat_mons:
-            m.observe(now, ms)
-        orig_lat(label, ms)
+    def _tee_service(svc) -> None:
+        orig_lat = svc._latency.record
+        orig_qw = svc._queue_wait.record
 
-    def _qw_record(label: str, ms: float) -> None:
-        now = clk()
-        qw_stream.observe(ms)
-        for m in qw_mons:
-            m.observe(now, ms)
-        orig_qw(label, ms)
+        def _lat_record(label: str, ms: float) -> None:
+            now = clk()
+            latencies.append(float(ms))
+            lat_stream.observe(ms)
+            lat_drift.observe(ms)
+            for m in lat_mons:
+                m.observe(now, ms)
+            orig_lat(label, ms)
 
-    service._latency.record = _lat_record
-    service._queue_wait.record = _qw_record
+        def _qw_record(label: str, ms: float) -> None:
+            now = clk()
+            qw_stream.observe(ms)
+            for m in qw_mons:
+                m.observe(now, ms)
+            orig_qw(label, ms)
+
+        svc._latency.record = _lat_record
+        svc._queue_wait.record = _qw_record
+        tees.append((svc, orig_lat, orig_qw))
+
+    if router is None:
+        _tee_service(service)
+    else:
+        for _rep in router.replicas:
+            _tee_service(_rep.service)
 
     # -- crash-restart -----------------------------------------------------
     restart_state: Dict = {"done": False, "info": None}
@@ -504,7 +628,7 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         """Kill the service without drain at the spec'd virtual
         instant, rebuild it from the durability directory, and splice
         the recovered handles back into the replay."""
-        nonlocal service, orig_lat, orig_qw
+        nonlocal service
         if (not restart_enabled or restart_state["done"]
                 or crash_at is None or clk() < t0 + float(crash_at)):
             return
@@ -517,8 +641,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         # the crash: drop the service AND its plan with no drain —
         # queued requests and in-flight batches vanish exactly as if
         # the process died; only the journal + snapshot survive
-        service._latency.record = orig_lat
-        service._queue_wait.record = orig_qw
+        dead, orig_lat, orig_qw = tees.pop()
+        dead._latency.record = orig_lat
+        dead._queue_wait.record = orig_qw
         t_wall = time.perf_counter()
         service = SolveService(
             _serve_options(_new_plan()), clock=clk,
@@ -530,10 +655,7 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
             service.shed_signal = lambda: any(m.firing for m in monitors)
         if exporter is not None:
             service.attach_exporter(exporter)
-        orig_lat = service._latency.record
-        orig_qw = service._queue_wait.record
-        service._latency.record = _lat_record
-        service._queue_wait.record = _qw_record
+        _tee_service(service)
         pending.extend(service.recovered_handles)
         rec = service.recovery or {}
         recovered = int(rec.get("recovered", 0))
@@ -590,9 +712,29 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                     if p is not None:
                         bundle_paths.append(p)
 
+    # fleet kill windows: fail-stop replicas mid-replay; detection and
+    # failover run on the router's heartbeat timeout inside poll()
+    kill_windows = [
+        {"replica": int(k[0]), "at_s": float(k[1]), "fired": False}
+        for k in (fleet_cfg.get("kill") or [])] if fleet_mode else []
+
+    def _maybe_kill() -> None:
+        now = clk()
+        for kw in kill_windows:
+            if not kw["fired"] and now >= t0 + kw["at_s"]:
+                kw["fired"] = True
+                try:
+                    router.kill(kw["replica"])
+                except KeyError:
+                    pass  # a spec naming a nonexistent replica is inert
+
     def _harvest() -> None:
         _fault_window(clk())
         _maybe_crash()
+        if fleet_mode:
+            _maybe_kill()
+            if exporter is not None:
+                exporter.maybe_export(clk())
         while pending and pending[0].done():
             h = pending.popleft()
             sr = h._result
@@ -649,7 +791,43 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         service.poll()
         service.flush_all()
         _harvest()
-        assert not pending, "requests left incomplete after flush_all"
+        if fleet_mode:
+            # fire any kills scheduled past the last arrival, then let
+            # the heartbeat silence age so detection + failover run,
+            # drain the re-homed twins, and pump the orphan bridges
+            for kw in kill_windows:
+                if not kw["fired"]:
+                    clk.advance_to(t0 + kw["at_s"])
+                    _harvest()
+            clk.advance(float(fleet_cfg.get("heartbeat_timeout_ms")
+                              or 250.0) / 1e3 + poll_dt)
+            service.poll()
+            service.flush_all()
+            service.poll()
+            _harvest()
+            # the throughput headline's wall clock is when the LAST
+            # replica went idle — account the modeled busy tails the
+            # arrival schedule never reached
+            for state in replica_busy.values():
+                clk.advance_to(state["busy_until"])
+            if pending:
+                # an orphan whose re-home was lost never completes;
+                # count completed stragglers stuck behind it, leave
+                # the rest to the hung/lost accounting below
+                done_stragglers = [h for h in pending if h.done()]
+                open_stragglers = len(pending) - len(done_stragglers)
+                pending.clear()
+                pending.extend(done_stragglers)
+                _harvest()
+                pending.clear()  # the open ones count as hung below
+                if open_stragglers:
+                    obs_registry.counter(
+                        "fleet.lost",
+                        "requests lost across a failover (orphans "
+                        "whose re-home could not land)").inc(
+                            open_stragglers)
+        else:
+            assert not pending, "requests left incomplete after flush_all"
         now = clk()
         if exporter is not None:
             exporter.export(now)
@@ -657,8 +835,9 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
         if fault_state["armed"]:
             _faults.arm(fault_state["restore"])
             fault_state["armed"] = False
-        service._latency.record = orig_lat
-        service._queue_wait.record = orig_qw
+        for svc, orig_lat, orig_qw in tees:
+            svc._latency.record = orig_lat
+            svc._queue_wait.record = orig_qw
         obs_trace.remove_sink(acc.ingest)
         obs_flight.set_clock(None)
         if not trace_was_on:
@@ -694,6 +873,32 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
                      if counts["submitted"] else 0.0)
         restart_report["lost_request_rate"] = round(lost_rate, 6)
         recovery_ms = restart_report["restart_recovery_ms"]
+    fleet_report: Dict = {"enabled": bool(fleet_mode)}
+    replica_lost_rate = None
+    if fleet_mode:
+        fs = router.fleet_stats()
+        # a request the fleet accepted but never brought to a terminal
+        # status — the headline the chaos gate pins to zero
+        replica_lost_rate = (counts["hung"] / counts["submitted"]
+                             if counts["submitted"] else 0.0)
+        fleet_report.update({
+            "n_replicas": fs["n_replicas"],
+            "alive": fs["alive"],
+            "failovers": fs["failovers"],
+            "rehomed": fs["rehomed"],
+            "rehome_lost": fs["rehome_lost"],
+            "fleet_shed": fs["fleet_shed"],
+            "gossip": fs["gossip"],
+            "kills": [{"replica": kw["replica"], "at_s": kw["at_s"],
+                       "fired": kw["fired"]} for kw in kill_windows],
+            "replica_lost_request_rate": round(replica_lost_rate, 6),
+            # fleet-aggregate warm hit rate (dead replicas contribute
+            # their at-death snapshot): the failover smoke pins this
+            # non-degraded vs a kill-free run of the same stream
+            "warm_hit_rate": round(
+                router.metrics()["warm_start"]["hit_rate"], 6),
+            "per_replica": fs["per_replica"],
+        })
     report = {
         "schema": SOAK_SCHEMA,
         "virtual": bool(virtual),
@@ -727,11 +932,15 @@ def run_soak(spec: Optional[Dict] = None, *, nlp=None, base_solver=None,
             "recovery_rate": round(recovery_rate, 6),
         },
         "restart": restart_report,
+        "fleet": fleet_report,
         "soak_p99_ms": lat_summary.get("p99"),
         "slo_burn_max": round(burn_max, 4),
         "fault_recovery_rate": round(recovery_rate, 6),
         "restart_recovery_ms": recovery_ms,
         "lost_request_rate": lost_rate,
+        "replica_lost_request_rate": (
+            None if replica_lost_rate is None
+            else round(replica_lost_rate, 6)),
     }
     if out_dir:
         import os
@@ -762,6 +971,15 @@ def format_soak_report(report: Dict) -> str:
             f"recovered (rate {fl['recovery_rate']:.3f}), "
             f"{fl['plan_retries']} plan retr{'y' if fl['plan_retries'] == 1 else 'ies'}, "
             f"{fl['shed']} shed")
+    ft = report.get("fleet")
+    if ft and ft.get("enabled") and "n_replicas" in ft:
+        kills = sum(1 for k in ft.get("kills", ()) if k["fired"])
+        lines.append(
+            f"fleet: {ft['alive']}/{ft['n_replicas']} replicas alive, "
+            f"{kills} killed, {ft['failovers']} failover(s), "
+            f"{ft['rehomed']} re-homed, {ft['rehome_lost']} lost in "
+            f"handoff (replica_lost_request_rate "
+            f"{ft['replica_lost_request_rate']:.4f})")
     rs = report.get("restart")
     if rs and rs.get("enabled") and "open_at_crash" in rs:
         lines.append(

@@ -9,10 +9,14 @@ moves QUEUED → DISPATCHED → terminal.  A service that dies mid-flight
 leaves a journal whose non-terminal requests are exactly the ones a
 fresh process must resubmit; :func:`replay` reconstructs that set,
 tolerating a torn final record (a crash mid-``write`` truncates the
-last line, never corrupts earlier ones), and deduplicates by
-fingerprint so replaying the same journal twice — or a journal that
-already contains a previous recovery's re-accepts — never submits a
-request twice.
+last line, never corrupts earlier ones).  The open set is keyed by
+``request_id`` — two distinct in-flight requests with bitwise-equal
+params (same fingerprint) are two open requests and both replay.
+Resubmit idempotency rides on the ``orig`` link instead: a recovery's
+re-accept names the request id it supersedes, so a journal that
+already contains a previous recovery's re-accepts replays each
+original request exactly once (the fingerprint stays in the record
+for affinity/warm-start keying, never for deduplication).
 
 Layout and rotation: records are JSON lines appended to numbered
 segments (``journal-00001.jsonl`` …).  A segment is rotated after
@@ -204,10 +208,15 @@ class RequestJournal:
 
     def accept(self, request_id: int, fingerprint: str, *, solver: str,
                options: Optional[Dict], deadline_ms: Optional[float],
-               t: float, params) -> None:
+               t: float, params, origin: Optional[int] = None) -> None:
         """Journal an accepted request (status QUEUED) with its full
-        payload — written before the request can possibly complete."""
-        self._write({
+        payload — written before the request can possibly complete.
+
+        ``origin`` marks a recovery resubmission: the request id (in
+        this same directory's journal) that this accept supersedes.
+        Replay closes the superseded id, so a crash-recover-crash
+        sequence replays each original request exactly once."""
+        rec = {
             "k": "a",
             "id": int(request_id),
             "fp": fingerprint,
@@ -216,7 +225,10 @@ class RequestJournal:
             "deadline_ms": deadline_ms,
             "t": float(t),
             "params": encode_tree(params),
-        })
+        }
+        if origin is not None:
+            rec["orig"] = int(origin)
+        self._write(rec)
 
     def status(self, request_ids: Sequence[int], status: str) -> None:
         """Journal a status transition for a batch of requests."""
@@ -247,14 +259,20 @@ class JournalReplay:
     """The reconstructed journal state: what to resubmit, and counts."""
 
     def __init__(self):
-        self.accepted = 0            # accept records seen (pre-dedupe)
+        self.accepted = 0            # accept records seen
         self.torn = 0                # undecodable lines skipped
         self.clean_shutdown = False  # a clean marker was the last word
-        #: open requests in original accept order, deduped by
-        #: fingerprint: list of dicts with fp/solver/opts/deadline_ms/
-        #: params (decoded) ready for resubmission
+        #: open requests in original accept order, keyed by request id
+        #: (an ``orig``-linked re-accept supersedes the id it names):
+        #: list of dicts with id/fp/solver/options/deadline_ms/params
+        #: (decoded) ready for resubmission
         self.open_requests: List[Dict] = []
         self.lost = 0                # accepts whose payload failed decode
+        #: highest request id any accept carried — a recovering service
+        #: seeds its request counter past it, so re-accept ids never
+        #: collide with a prior generation's (ids are unique per
+        #: journal directory, which the orig-supersede link relies on)
+        self.max_id = 0
 
 
 def _segments(directory: str) -> List[str]:
@@ -271,17 +289,20 @@ def replay(directory: str) -> JournalReplay:
     Torn records (a line that fails to parse — the tail of a segment
     truncated by a crash mid-write) are counted and skipped; every
     record before the tear was flushed whole, so nothing earlier is at
-    risk.  Duplicate accepts for the same fingerprint collapse to the
-    newest (idempotent replay), and a fingerprint with *any* terminal
-    status is closed.
+    risk.  The open set is keyed by request id: a request is open when
+    its latest status is non-terminal AND no later accept names it via
+    ``orig`` (a recovery re-accept supersedes the id it replayed, so
+    recovering twice from the same directory never resubmits a request
+    twice).  Two distinct requests with identical params — same
+    fingerprint, different ids — are both open and both replay.
     """
     out = JournalReplay()
     if not os.path.isdir(directory):
         return out
-    accepts: Dict[str, Dict] = {}      # fp -> newest accept record
-    order: List[str] = []              # fps in first-accept order
-    status_of: Dict[int, str] = {}     # request id -> latest status
-    ids_of: Dict[str, List[int]] = {}  # fp -> its request ids
+    accepts: Dict[int, Dict] = {}    # request id -> its accept record
+    order: List[int] = []            # ids in accept order
+    status_of: Dict[int, str] = {}   # request id -> latest status
+    superseded: set = set()          # ids replaced by a recovery re-accept
     for path in _segments(directory):
         with open(path, "r", encoding="utf-8") as fh:
             for line in fh:
@@ -297,12 +318,15 @@ def replay(directory: str) -> JournalReplay:
                 if kind == "a":
                     out.accepted += 1
                     out.clean_shutdown = False
-                    fp = rec["fp"]
-                    if fp not in accepts:
-                        order.append(fp)
-                    accepts[fp] = rec
-                    ids_of.setdefault(fp, []).append(int(rec["id"]))
-                    status_of[int(rec["id"])] = "QUEUED"
+                    rid = int(rec["id"])
+                    out.max_id = max(out.max_id, rid)
+                    if rid not in accepts:
+                        order.append(rid)
+                    accepts[rid] = rec
+                    status_of[rid] = "QUEUED"
+                    orig = rec.get("orig")
+                    if orig is not None:
+                        superseded.add(int(orig))
                 elif kind == "s":
                     for rid in rec.get("ids", ()):
                         status_of[int(rid)] = rec["st"]
@@ -310,18 +334,20 @@ def replay(directory: str) -> JournalReplay:
                     out.clean_shutdown = bool(rec.get("clean"))
     if out.clean_shutdown:
         return out
-    for fp in order:
-        ids = ids_of.get(fp, ())
-        if any(status_of.get(i) in TERMINAL_STATUSES for i in ids):
+    for rid in order:
+        if rid in superseded:
             continue
-        rec = accepts[fp]
+        if status_of.get(rid) in TERMINAL_STATUSES:
+            continue
+        rec = accepts[rid]
         try:
             params = decode_tree(rec["params"])
         except Exception:
             out.lost += 1
             continue
         out.open_requests.append({
-            "fp": fp,
+            "id": rid,
+            "fp": rec["fp"],
             "solver": rec.get("solver") or "pdlp",
             "options": _decode_options(rec.get("opts")),
             "deadline_ms": rec.get("deadline_ms"),

@@ -499,7 +499,8 @@ class SolveService:
     directory holds both.  ``recover_dir`` rebuilds a service from a
     predecessor's directory: the snapshot restores the warm-start
     caches, admission estimators and degradation rungs; the journal's
-    non-terminal requests are resubmitted (idempotent by fingerprint)
+    non-terminal requests are resubmitted (idempotent via the
+    ``orig`` re-accept link)
     through ``recover_nlp``/``recover_base_solver``, landing in
     ``recovered_handles`` with counts in ``recovery``.
     """
@@ -584,6 +585,9 @@ class SolveService:
         self._draining = False
         self.recovered_handles: List[SolveHandle] = []
         self.recovery: Optional[Dict] = None
+        # while recovering, the journal id each resubmission supersedes
+        # (journal.accept(origin=...) — replay closes the original)
+        self._resubmit_origin: Optional[int] = None
         self._journal = None
         self._snapshots = None
         durable_dir = journal_dir
@@ -601,6 +605,10 @@ class SolveService:
             if state is not None:
                 snapshot_mod.apply_to_service(self, state)
             replayed = journal_mod.replay(recover_dir)
+            if replayed.max_id:
+                # ids must stay unique across generations sharing this
+                # directory — the orig-supersede link keys on them
+                self._request_seq = itertools.count(replayed.max_id + 1)
         if durable_dir is not None:
             if snapshot_interval_s is None:
                 raw = os.environ.get(
@@ -631,6 +639,7 @@ class SolveService:
                 lost += 1
                 continue
             try:
+                self._resubmit_origin = rec.get("id")
                 handle = self.submit(
                     nlp, rec["params"], solver=rec["solver"],
                     options=rec["options"],
@@ -639,6 +648,8 @@ class SolveService:
             except Exception:
                 lost += 1
                 continue
+            finally:
+                self._resubmit_origin = None
             self.recovered_handles.append(handle)
             recovered += 1
         self.recovery = {
@@ -813,7 +824,7 @@ class SolveService:
             self._journal.accept(
                 handle.request_id, request_fingerprint(params),
                 solver=solver, options=options, deadline_ms=deadline_ms,
-                t=now, params=params)
+                t=now, params=params, origin=self._resubmit_origin)
         with self._lock:
             bucket.pending.append(handle)
             bucket.stats.record_submitted()

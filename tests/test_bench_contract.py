@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r12_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r13_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -66,6 +66,11 @@ def test_preview_record_passes_schema(bench):
         assert key in out["crash_restart"]
     for key in bench.CRASH_RESTART_NONNULL_KEYS:
         assert out["crash_restart"][key] is not None
+    # the fleet A/B (r13 preview, ISSUE 17): headline metrics measured
+    for key in bench.FLEET_KEYS:
+        assert key in out["fleet"]
+    for key in bench.FLEET_NONNULL_KEYS:
+        assert out["fleet"][key] is not None
     # the adaptive-scheduler A/B (r12, ISSUE 14)
     for key in bench.SCHED_KEYS:
         assert key in out["scheduler"]
@@ -365,6 +370,18 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["crash_restart"]
     bench.validate_bench_output(out)
+    # fleet (ISSUE 17): optional-but-complete, headlines non-null
+    out = json.load(open(PREVIEW))
+    del out["fleet"]["fleet_scaling_efficiency"]
+    with pytest.raises(ValueError, match="fleet_scaling_efficiency"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["fleet"]["replica_lost_request_rate"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["fleet"]
+    bench.validate_bench_output(out)
     # scheduler (r12): optional-but-complete, both arms carry the full
     # per-arm key set
     out = json.load(open(PREVIEW))
@@ -425,6 +442,32 @@ def test_preview_crash_restart_section(bench):
     assert cr["requests_done"] <= cr["n_requests"]
     assert (cr["warm_hit_rate_post"]
             >= cr["warm_hit_rate_pre"] - 0.1)
+
+
+def test_preview_fleet_section(bench):
+    """The ISSUE-17 fleet A/B backs the replication acceptance: on
+    identical virtual request streams, 3 replicas deliver at least
+    0.7x per-replica parity with the 1-replica baseline
+    (fleet_scaling_efficiency — the replication tax), and the
+    kill-one-mid-soak arm drives every accepted request to a terminal
+    status through journal handoff (replica_lost_request_rate exactly
+    0, zero hung handles, at least one re-homed request)."""
+    out = json.load(open(PREVIEW))
+    fleet = out["fleet"]
+    assert fleet["n_requests"] > 0
+    assert fleet["n_replicas"] == 3
+    assert 0.0 < fleet["solves_per_sec_1"] < fleet["solves_per_sec_3"]
+    assert fleet["fleet_scaling_efficiency"] == pytest.approx(
+        fleet["solves_per_sec_3"] / (3 * fleet["solves_per_sec_1"]),
+        abs=5e-4)
+    # the ISSUE-17 acceptance floor
+    assert fleet["fleet_scaling_efficiency"] >= 0.7
+    assert fleet["kill_at_s"] > 0
+    assert fleet["failovers"] == 1
+    assert fleet["rehomed"] > 0
+    assert fleet["replica_lost_request_rate"] == 0.0
+    assert fleet["hung"] == 0
+    assert 0 < fleet["requests_done_kill"] <= fleet["n_requests"]
 
 
 def test_bench_record_round_trips_through_ledger(bench, tmp_path):

@@ -8,8 +8,10 @@ Coverage, all on stub kernels and fake/real-but-instant clocks
 * the journal codec round-trips a params pytree **bitwise** (the
   resubmitted fingerprint equals the journaled one);
 * ``replay`` reconstructs exactly the open set — terminal statuses
-  close a fingerprint, duplicate accepts dedupe, a torn trailing
-  record is skipped and counted, and replaying twice is idempotent;
+  close a request id, ``orig``-linked re-accepts supersede the id
+  they recovered (same-fingerprint distinct requests never collapse),
+  a torn trailing record is skipped and counted, and replaying twice
+  is idempotent;
 * a clean ``drain()`` marker empties the replay (nothing to recover
   from an orderly exit) and closes the service to new submissions;
 * ``SolveService(recover_dir=...)`` resubmits every request open at
@@ -112,10 +114,15 @@ def test_journal_replay_open_set_torn_tail_and_idempotence(tmp_path):
         j.accept(i, f"fp-{i}", solver="pdlp", options=None,
                  deadline_ms=50.0 if i == 1 else None, t=float(i),
                  params={"x": np.array([float(i)])})
-    # a duplicate accept for fp-4 (a previous recovery's re-accept):
-    # replay must collapse it to one open request
+    # a previous recovery's re-accept of request 4: the orig link
+    # supersedes id 4, so replay opens the re-accept (id 6) only
     j.accept(6, "fp-4", solver="pdlp", options=None, deadline_ms=None,
-             t=6.0, params={"x": np.array([4.0])})
+             t=6.0, params={"x": np.array([4.0])}, origin=4)
+    # a genuinely distinct request with fp-5's exact params: NOT a
+    # duplicate — both it and request 5 must replay (the satellite
+    # regression: same-fingerprint open requests never collapse)
+    j.accept(7, "fp-5", solver="pdlp", options=None, deadline_ms=None,
+             t=7.0, params={"x": np.array([5.0])})
     j.status([1, 2], "DISPATCHED")
     j.status([2], "DONE")
     j.status([3], "TIMEOUT")
@@ -130,15 +137,18 @@ def test_journal_replay_open_set_torn_tail_and_idempotence(tmp_path):
     rep = journal.replay(d)
     assert rep.torn == 1
     assert not rep.clean_shutdown
-    assert rep.accepted == 6
+    assert rep.accepted == 7
+    open_ids = [r["id"] for r in rep.open_requests]
     open_fps = [r["fp"] for r in rep.open_requests]
-    assert open_fps == ["fp-1", "fp-4", "fp-5"]  # 2 DONE, 3 TIMEOUT
+    # 2 DONE, 3 TIMEOUT, 4 superseded by its re-accept 6
+    assert open_ids == [1, 5, 6, 7]
+    assert open_fps == ["fp-1", "fp-5", "fp-4", "fp-5"]
     assert rep.open_requests[0]["deadline_ms"] == 50.0
-    np.testing.assert_array_equal(rep.open_requests[1]["params"]["x"],
+    np.testing.assert_array_equal(rep.open_requests[2]["params"]["x"],
                                   [4.0])
     # replaying the same journal twice reconstructs the same set
     rep2 = journal.replay(d)
-    assert [r["fp"] for r in rep2.open_requests] == open_fps
+    assert [r["id"] for r in rep2.open_requests] == open_ids
 
 
 def test_journal_clean_shutdown_empties_replay(tmp_path):
@@ -232,6 +242,38 @@ def test_service_crash_recovery_completes_open_requests(tmp_path, stub_nlp,
     assert svc3.recovery["recovered"] == 0
     assert svc3.recovery["clean_shutdown"]
     assert svc3.recovered_handles == []
+
+
+def test_crash_recovery_keeps_both_same_params_requests(tmp_path, stub_nlp,
+                                                        stub_solver):
+    """The satellite regression: two distinct in-flight requests with
+    bitwise-identical params (same fingerprint) were collapsed by the
+    fingerprint-keyed replay and one was silently lost.  The id-keyed
+    open set recovers both — and a second crash mid-recovery still
+    replays each exactly once (the ``orig`` re-accept link)."""
+    d = str(tmp_path)
+    svc1 = _new_service(journal_dir=d)
+    same = _params(stub_nlp, 7)
+    a = svc1.submit(stub_nlp, same, solver="pdlp", base_solver=stub_solver)
+    b = svc1.submit(stub_nlp, same, solver="pdlp", base_solver=stub_solver)
+    assert a.request_id != b.request_id
+    assert not a.done() and not b.done()
+    del svc1, a, b  # crash: both requests open, identical payloads
+
+    svc2 = _new_service(recover_dir=d, recover_nlp=stub_nlp,
+                        recover_base_solver=stub_solver)
+    assert svc2.recovery["recovered"] == 2
+    assert svc2.recovery["lost"] == 0
+    del svc2  # crash again before the recovered pair dispatches
+
+    # the journal now holds the originals AND their orig-linked
+    # re-accepts: a second recovery must see exactly two open requests
+    svc3 = _new_service(recover_dir=d, recover_nlp=stub_nlp,
+                        recover_base_solver=stub_solver)
+    assert svc3.recovery["recovered"] == 2
+    svc3.flush_all()
+    assert all(h.result().status == RequestStatus.DONE
+               for h in svc3.recovered_handles)
 
 
 def test_drain_closes_submissions_and_is_idempotent(tmp_path, stub_nlp,

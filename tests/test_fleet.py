@@ -1,0 +1,454 @@
+"""Fleet tier (ISSUE 17): replicated SolveService behind FleetRouter.
+
+Pins the fleet contracts:
+
+* **single-replica parity** — ``FleetRouter`` at ``n_replicas=1`` is a
+  pure pass-through: bitwise-identical results to a bare
+  ``SolveService`` on the same stream, and none of the fleet machinery
+  (gossip, heartbeats, tracking maps) is ever touched;
+* **routing** — power-of-two-choices with the deadline-slack penalty,
+  fingerprint affinity, and the fleet-level shed rung;
+* **failover** — a killed replica is detected by heartbeat silence,
+  its journal replayed, open requests re-homed onto survivors and the
+  orphaned pre-crash handles bridged to terminal status (the fleet
+  no-hang contract);
+* **gossip** — warm-start index entries cross replicas through the
+  snapshot codec, service-time estimates are adopted cold-only;
+* **soak integration** — the ``fleet`` spec section drives a chaos
+  replay with kill windows and reports ``replica_lost_request_rate``.
+
+All on the virtual clock + stub kernel: no real solver compiles.
+"""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.faults import inject as faults
+from dispatches_tpu.fleet import (FleetOptions, FleetRouter, Gossip,
+                                  ReplicaHandle)
+from dispatches_tpu.obs.soak import (FakeClock, StubNLP, make_stub_solver,
+                                     run_soak)
+from dispatches_tpu.plan import ExecutionPlan, PlanOptions
+from dispatches_tpu.serve import RequestStatus, ServeOptions, SolveService
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    """Every test starts and ends disarmed, journal env unset."""
+    monkeypatch.delenv("DISPATCHES_TPU_SERVE_JOURNAL_DIR", raising=False)
+    monkeypatch.delenv("DISPATCHES_TPU_OBS_FLIGHT_DIR", raising=False)
+    for flag in ("FLEET_REPLICAS", "FLEET_HEARTBEAT_MS",
+                 "FLEET_GOSSIP_INTERVAL_S"):
+        monkeypatch.delenv(f"DISPATCHES_TPU_{flag}", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def stub_nlp():
+    return StubNLP()
+
+
+@pytest.fixture(scope="module")
+def stub_solver():
+    return make_stub_solver()
+
+
+def _service(clock, **kw):
+    plan = ExecutionPlan(PlanOptions(inflight=2))
+    return SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                     warm_start=False, plan=plan),
+                        clock=clock, **kw)
+
+
+def _params(nlp, i):
+    p = nlp.default_params()
+    p["p"]["price"] = p["p"]["price"] * (1.0 + 0.01 * i)
+    return p
+
+
+def _router(n, clock, *, durable_dir=None, **opt_kw):
+    opts = FleetOptions(n_replicas=n, **opt_kw)
+
+    def make_service(replica_id, journal_dir):
+        return _service(clock, journal_dir=journal_dir)
+
+    return FleetRouter(opts, clock=clock, make_service=make_service,
+                       durable_dir=durable_dir)
+
+
+def _submit(target, nlp, solver_fn, i, **kw):
+    return target.submit(nlp, _params(nlp, i), solver="pdlp",
+                         base_solver=solver_fn, **kw)
+
+
+# ---------------------------------------------------------------------------
+# satellite: single-replica parity + disarmed-fleet spy pin
+# ---------------------------------------------------------------------------
+
+
+def test_single_replica_parity_bitwise(stub_nlp, stub_solver):
+    """n_replicas=1 through the router is bitwise-identical to the
+    bare service on the same stream: same statuses, same request ids,
+    same objectives, same result arrays bit for bit."""
+    clk = FakeClock()
+    bare = _service(clk)
+    router = _router(1, clk)
+
+    bare_handles, fleet_handles = [], []
+    for i in range(9):
+        bare_handles.append(_submit(bare, stub_nlp, stub_solver, i))
+        fleet_handles.append(_submit(router, stub_nlp, stub_solver, i))
+        clk.advance(0.002)
+        bare.poll()
+        router.poll()
+    assert bare.flush_all() == router.flush_all()
+
+    for hb, hf in zip(bare_handles, fleet_handles):
+        assert hb.done() and hf.done()
+        rb, rf = hb.result(), hf.result()
+        assert rb.status == rf.status == RequestStatus.DONE
+        assert hb.request_id == hf.request_id
+        assert rb.obj == rf.obj  # exact: identical programs + inputs
+        np.testing.assert_array_equal(np.asarray(rb.result.obj),
+                                      np.asarray(rf.result.obj))
+
+    # service-shaped metrics agree on every count the bare service has
+    mb, mf = bare.metrics(), router.metrics()
+    for key in ("submitted", "solved", "errors", "shed", "batches",
+                "flushes", "queue_depth"):
+        assert mb[key] == mf[key], key
+    assert mf["fleet"]["n_replicas"] == 1
+
+
+def test_single_replica_mode_never_touches_fleet_machinery(
+        monkeypatch, stub_nlp, stub_solver):
+    """The disarmed-fleet pin: at n_replicas=1 the router must never
+    construct a Gossip, beat a heartbeat, journal, or track a request
+    — spies that raise prove the pass-through stays pure."""
+
+    def _boom(*a, **kw):
+        raise AssertionError("fleet machinery touched in single mode")
+
+    monkeypatch.setattr(Gossip, "__init__", _boom)
+    monkeypatch.setattr(ReplicaHandle, "heartbeat", _boom)
+    clk = FakeClock()
+    router = _router(1, clk)
+    assert router._gossip is None
+    assert router.durable_dir is None  # no implied journal at n=1
+
+    h = _submit(router, stub_nlp, stub_solver, 0)
+    clk.advance(0.01)
+    router.poll()
+    router.flush_all()
+    assert h.done() and h.result().status == RequestStatus.DONE
+    assert router._tracked == {} and router._bridges == []
+    assert router.replicas[0].beats == 0
+
+
+# ---------------------------------------------------------------------------
+# routing: p2c + slack penalty, affinity, fleet shed
+# ---------------------------------------------------------------------------
+
+
+def test_router_spreads_load_across_replicas(stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(3, clk)
+    for i in range(30):
+        _submit(router, stub_nlp, stub_solver, i)
+    depths = [r.queue_depth() for r in router.replicas]
+    # p2c never piles everything on one replica (max_batch=4 flushes
+    # full batches on submit, so depths stay small but spread)
+    per = {r.name: (r.metrics() or {})["submitted"]
+           for r in router.replicas}
+    assert all(n > 0 for n in per.values()), per
+    assert sum(per.values()) == 30
+    assert sum(depths) == router.metrics()["queue_depth"]
+
+
+def test_slack_penalty_steers_deadline_traffic(stub_nlp, stub_solver):
+    """_score adds the slack penalty exactly when the queue ahead of
+    the request would burn its deadline at the replica's own
+    service-time estimate."""
+    clk = FakeClock()
+    router = _router(2, clk)
+    replica = router.replicas[0]
+    # form a bucket, then teach its admission estimate a 100 ms batch
+    # (the virtual replay solves in zero virtual time, so the sample
+    # must be fed directly to exercise the slack arithmetic)
+    h = _submit(replica.service, stub_nlp, stub_solver, 0)
+    replica.service.flush_all()
+    assert h.done()
+    bucket = next(iter(replica.service._buckets.values()))
+    bucket.est.observe_ms(100.0)
+    est = replica.est_service_s()
+    assert est is not None and est > 0.0
+    # a deadline far beyond the estimate: plain depth score
+    assert router._score(replica, est * 1e6, clk()) == float(
+        replica.queue_depth())
+    # a deadline tighter than one batch's estimate: penalty dominates
+    assert router._score(replica, est * 1e3 / 2.0, clk()) >= 1e6
+    # no deadline: depth only, regardless of the estimate
+    assert router._score(replica, None, clk()) == float(
+        replica.queue_depth())
+
+
+def test_affinity_routes_repeats_to_same_replica(stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(3, clk)
+    same = _params(stub_nlp, 5)
+    router.submit(stub_nlp, same, solver="pdlp", base_solver=stub_solver)
+    router.flush_all()
+    home = next(iter(router._affinity.values()))
+    for _ in range(5):
+        router.submit(stub_nlp, {"p": {"price": same["p"]["price"]},
+                                 "fixed": {}},
+                      solver="pdlp", base_solver=stub_solver)
+    # every repeat landed on the same replica as the first submit
+    assert len(set(router._affinity.values())) == 1
+    assert next(iter(router._affinity.values())) == home
+
+
+def test_fleet_shed_refuses_when_all_replicas_saturated(
+        stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(2, clk, shed_queue_depth=3)
+    handles = [_submit(router, stub_nlp, stub_solver, i)
+               for i in range(40)]
+    shed = [h for h in handles if h.status == RequestStatus.SHED]
+    routed = [h for h in handles if h.status != RequestStatus.SHED]
+    assert shed, "40 submits against depth rung 3 x 2 replicas must shed"
+    # fleet-shed handles are terminal immediately, with negative ids
+    for h in shed:
+        assert h.done() and h.request_id < 0
+        assert h.bucket_label == "fleet"
+        assert h.result().status == RequestStatus.SHED
+    assert router.metrics()["shed"] >= len(shed)
+    router.flush_all()
+    assert all(h.done() for h in routed)
+
+
+def test_router_submit_fault_site_sheds(stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(2, clk)
+    faults.arm("router.submit,p=1.0,times=1")
+    try:
+        h = _submit(router, stub_nlp, stub_solver, 0)
+        assert h.done() and h.result().status == RequestStatus.SHED
+        h2 = _submit(router, stub_nlp, stub_solver, 1)  # budget spent
+        assert h2.status != RequestStatus.SHED
+    finally:
+        faults.reset()
+
+
+def test_shed_signal_refuses_at_the_router(stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(2, clk)
+    router.shed_signal = lambda: True
+    h = _submit(router, stub_nlp, stub_solver, 0)
+    assert h.done() and h.result().status == RequestStatus.SHED
+    router.shed_signal = None
+    assert _submit(router, stub_nlp, stub_solver,
+                   1).status != RequestStatus.SHED
+
+
+# ---------------------------------------------------------------------------
+# failover: heartbeat detection, journal handoff, handle bridging
+# ---------------------------------------------------------------------------
+
+
+def test_failover_rehomes_open_requests_and_bridges_handles(
+        tmp_path, stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(3, clk, durable_dir=str(tmp_path),
+                     heartbeat_timeout_ms=250.0)
+    handles = [_submit(router, stub_nlp, stub_solver, i)
+               for i in range(12)]
+    # no poll yet: polling past max_wait would flush the queues —
+    # the kill must catch requests mid-air
+    victim = max(router.replicas, key=lambda r: r.queue_depth())
+    open_before = victim.queue_depth()
+    assert open_before > 0, "need open work on the victim"
+    orphans = [h for h in handles if not h.done()
+               and router._tracked.get(
+                   (victim.replica_id, h.request_id)) is not None]
+
+    router.kill(victim.replica_id)
+    assert not victim.alive and victim.service is None
+    # detection is heartbeat-timeout honest, never instantaneous
+    router.poll()
+    assert router.failovers == 0
+    clk.advance(0.3)  # past the 250 ms timeout
+    router.poll()
+    assert router.failovers == 1 and victim.failed_over
+    assert router.rehomed >= open_before
+    assert router.rehome_lost == 0
+
+    router.flush_all()
+    router.poll()
+    # the fleet no-hang contract: every accepted handle is terminal,
+    # including the orphans minted against the dead replica
+    assert all(h.done() for h in handles)
+    for h in orphans:
+        assert h.result().status == RequestStatus.DONE
+    stats = router.fleet_stats()
+    assert stats["alive"] == 2 and stats["bridges_open"] == 0
+    # a journal is re-homed at most once: further polls are no-ops
+    clk.advance(1.0)
+    router.poll()
+    assert router.failovers == 1
+
+
+def test_wedged_poll_is_failstop_and_fails_over(tmp_path, stub_nlp,
+                                                stub_solver):
+    """A replica whose poll raises past its own failure domains is
+    treated as crashed; the heartbeat timeout then fails it over."""
+    clk = FakeClock()
+    router = _router(2, clk, durable_dir=str(tmp_path),
+                     heartbeat_timeout_ms=100.0)
+    victim = router.replicas[0]
+
+    def _wedged(now=None):
+        raise RuntimeError("wedged")
+
+    victim.service.poll = _wedged
+    router.poll()
+    assert not victim.alive  # fail-stop containment
+    clk.advance(0.2)
+    router.poll()
+    assert router.failovers == 1
+    # the survivor still serves
+    h = _submit(router, stub_nlp, stub_solver, 0)
+    router.flush_all()
+    assert h.done() and h.result().status == RequestStatus.DONE
+
+
+def test_no_live_replicas_raises(stub_nlp, stub_solver):
+    clk = FakeClock()
+    router = _router(2, clk)
+    for replica in router.replicas:
+        router.kill(replica.replica_id)
+    with pytest.raises(RuntimeError, match="no live replicas"):
+        _submit(router, stub_nlp, stub_solver, 0)
+
+
+# ---------------------------------------------------------------------------
+# gossip: warm state crosses replicas through the snapshot codec
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_shares_warm_index_entries():
+    clk = FakeClock()
+    warm_solver = make_stub_solver(warm=True)
+    nlp = StubNLP()
+
+    def make_service(replica_id, journal_dir):
+        plan = ExecutionPlan(PlanOptions(inflight=2))
+        return SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                         warm_start=True, plan=plan),
+                            clock=clk, journal_dir=journal_dir)
+
+    router = FleetRouter(
+        FleetOptions(n_replicas=2, gossip_interval_s=1.0, affinity=False),
+        clock=clk, make_service=make_service)
+    # teach replica 0 some warm entries directly (bypass routing)
+    warm_opts = {"warm_contract": True, "warm_dims": (nlp.n, 1)}
+    donor = router.replicas[0].service
+    for i in range(4):
+        donor.submit(nlp, _params(nlp, i), solver="pdlp",
+                     base_solver=warm_solver, options=dict(warm_opts))
+    donor.flush_all()
+    donor_size = donor.metrics()["warm_start"]["size"]
+    assert donor_size > 0
+
+    recipient = router.replicas[1].service
+    # the recipient forms the same bucket cold
+    recipient.submit(nlp, _params(nlp, 99), solver="pdlp",
+                     base_solver=warm_solver, options=dict(warm_opts))
+    recipient.flush_all()
+
+    merged = router._gossip.exchange()
+    assert merged > 0
+    assert recipient.metrics()["warm_start"]["size"] > 1
+    # second round adopts nothing new: exact-key dedupe holds
+    assert router._gossip.exchange() == 0
+
+
+def test_gossip_est_adoption_is_cold_only():
+    clk = FakeClock()
+    solver = make_stub_solver()
+    nlp = StubNLP()
+    router = _router(2, clk, affinity=False)
+    donor = router.replicas[0].service
+    donor.submit(nlp, _params(nlp, 0), solver="pdlp", base_solver=solver)
+    donor.flush_all()
+    donor_bucket = next(iter(donor._buckets.values()))
+    assert donor_bucket.est.samples > 0
+
+    router._gossip.exchange()
+    recipient = router.replicas[1].service
+    # the recipient had not formed the bucket: state stashed for
+    # first formation (the snapshot-restore path)
+    assert donor_bucket.stats.label in recipient._restored_buckets
+    recipient.submit(nlp, _params(nlp, 1), solver="pdlp",
+                     base_solver=solver)
+    bucket = next(iter(recipient._buckets.values()))
+    assert bucket.est.samples > 0  # adopted cold, before any solve
+    own = bucket.est.samples
+    recipient.flush_all()
+    assert bucket.est.samples > own  # its own evidence keeps accruing
+
+
+# ---------------------------------------------------------------------------
+# env plumbing + soak integration
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_options_from_env(monkeypatch):
+    monkeypatch.setenv("DISPATCHES_TPU_FLEET_REPLICAS", "3")
+    monkeypatch.setenv("DISPATCHES_TPU_FLEET_HEARTBEAT_MS", "125.5")
+    monkeypatch.setenv("DISPATCHES_TPU_FLEET_GOSSIP_INTERVAL_S", "2.5")
+    opts = FleetOptions.from_env()
+    assert opts.n_replicas == 3
+    assert opts.heartbeat_timeout_ms == 125.5
+    assert opts.gossip_interval_s == 2.5
+    assert FleetOptions.from_env(n_replicas=1).n_replicas == 1
+
+
+def test_fleet_soak_chaos_kill_loses_nothing():
+    """The ISSUE-17 acceptance chaos run, small: 3 replicas on the
+    virtual stub replay, one killed mid-stream — every accepted
+    request reaches a terminal status and the fleet reports
+    replica_lost_request_rate == 0."""
+    rep = run_soak({
+        "traffic": {"rate_rps": 120.0, "duration_s": 2.0, "seed": 3,
+                    "deadline_ms": 2000.0},
+        "service": {"max_batch": 4, "max_wait_ms": 10.0, "inflight": 2},
+        "service_time": {"base_ms": 5.0, "per_lane_ms": 0.5,
+                         "jitter_ms": 0.5},
+        "fleet": {"n_replicas": 3, "kill": [[0, 1.0]],
+                  "heartbeat_timeout_ms": 150.0,
+                  "gossip_interval_s": 0.5},
+    })
+    fleet = rep["fleet"]
+    assert fleet["enabled"] and fleet["n_replicas"] == 3
+    assert fleet["alive"] == 2
+    assert fleet["failovers"] == 1
+    assert fleet["rehomed"] > 0 and fleet["rehome_lost"] == 0
+    assert rep["requests"]["hung"] == 0
+    assert fleet["replica_lost_request_rate"] == 0.0
+    assert rep["replica_lost_request_rate"] == 0.0
+    assert (rep["requests"]["done"] + rep["requests"]["timeout"]
+            + rep["requests"]["error"] + rep["requests"]["shed"]
+            == rep["requests"]["submitted"])
+
+
+def test_fleet_soak_rejects_bad_specs():
+    with pytest.raises(ValueError, match="virtual"):
+        run_soak({"traffic": {"rate_rps": 10.0, "duration_s": 0.1},
+                  "fleet": {"n_replicas": 2}}, virtual=False)
+    with pytest.raises(ValueError, match="mutually"):
+        run_soak({"traffic": {"rate_rps": 10.0, "duration_s": 0.1},
+                  "fleet": {"n_replicas": 2},
+                  "restart": {"enabled": True, "crash_at_s": 0.05}})
