@@ -324,6 +324,25 @@ WARMSTART_KEYS = ("lanes", "repeat_lanes", "steps", "rho", "sigma",
                   "obj_rel_err_cold", "obj_rel_err_warm")
 WARMSTART_NONNULL_KEYS = ("pdhg_iters_warm_ratio", "obj_rel_err_cold",
                           "obj_rel_err_warm")
+#: the learned warm-start predictor A/B (ISSUE 18): the ISSUE-12 drift
+#: stream replayed a third time with starts REGRESSED from the current
+#: step's parameters (learn.fit on a seeded micro-sweep, START_PREDICTED
+#: kinds) instead of retrieved from the previous step.
+#: ``pdhg_iters_pred_ratio`` (predicted/cold mean PDHG iterations, same
+#: cold denominator as the warm ratio) feeds the gated ledger; the
+#: ``cold_cache`` arm replays unseen parameter points against an EMPTY
+#: WarmStartIndex (the post-restart cache, k-NN scores 0 hits) where
+#: only a regressed start can help — ``iters_cut`` is cold/pred mean
+#: iterations there (higher is better, acceptance floor 1.5x)
+PREDICT_KEYS = ("lanes", "steps", "rho", "sigma", "train_points",
+                "hidden", "window", "refit_every",
+                "pdhg_iters_cold_mean", "pdhg_iters_pred_mean",
+                "pdhg_iters_pred_ratio",
+                "obj_rel_err_cold", "obj_rel_err_pred", "cold_cache")
+PREDICT_NONNULL_KEYS = ("pdhg_iters_pred_ratio",)
+PREDICT_COLD_CACHE_KEYS = ("points", "knn_hits", "pdhg_iters_cold_mean",
+                           "pdhg_iters_pred_mean", "iters_cut",
+                           "obj_rel_err_cold", "obj_rel_err_pred")
 #: the chaos-soak A/B (ISSUE 13): the SAME virtual-clock stub replay
 #: twice — clean, then with a seeded fault scenario (transient fence
 #: faults + one persistent poison rule) armed over a mid-replay window.
@@ -468,6 +487,21 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench warmstart headline metrics must be measured, "
                 f"not null: {nulls}")
+    pred = out.get("predict")
+    if pred is not None:
+        missing = [k for k in PREDICT_KEYS if k not in pred]
+        if missing:
+            raise ValueError(f"bench predict missing sub-keys: {missing}")
+        nulls = [k for k in PREDICT_NONNULL_KEYS if pred.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench predict headline metrics must be measured, "
+                f"not null: {nulls}")
+        cc = pred["cold_cache"]
+        missing = [k for k in PREDICT_COLD_CACHE_KEYS if k not in cc]
+        if missing:
+            raise ValueError(
+                f"bench predict cold_cache missing sub-keys: {missing}")
     chaos = out.get("chaos")
     if chaos is not None:
         missing = [k for k in CHAOS_KEYS if k not in chaos]
@@ -556,6 +590,12 @@ def _finalize_output(out):
         ws = out.get("warmstart") or {}
         if ws.get("pdhg_iters_warm_ratio") is not None:
             metrics["pdhg_iters_warm_ratio"] = ws["pdhg_iters_warm_ratio"]
+        # learned-predictor efficacy on the same drift stream is gated
+        # (lower is better): the guardrail for the regression head that
+        # serves where retrieval has nothing cached
+        pred = out.get("predict") or {}
+        if pred.get("pdhg_iters_pred_ratio") is not None:
+            metrics["pdhg_iters_pred_ratio"] = pred["pdhg_iters_pred_ratio"]
         # chaos section: recovery completeness is gated (higher is
         # better — 1.0 means nothing escaped the failure domains) and
         # the chaos arm's tail rides as its own gated metric so fault
@@ -1462,6 +1502,168 @@ def run_bench():
         }
     except Exception as exc:  # telemetry must never kill the headline
         out["warmstart_bench_error"] = str(exc)[:120]
+
+    # ---- learned warm-start predictor A/B (ISSUE 18 tentpole): train
+    # the learn/ regression head on a seeded micro-sweep (a disjoint
+    # AR(1) chain from the same traffic family, solved cold through the
+    # SAME compiled program), then replay the ISSUE-12 drift stream a
+    # third time through the predictor-enabled serve ladder: repeat
+    # lanes hit the exact-cache rung (their own previous solution,
+    # START_EXACT — just as they do in the retrieval arm), drift lanes
+    # take regressed starts from the OnlineTrainer, which observes
+    # every completed result and refits on a recency window each step —
+    # the shipped refit policy, never conditioned on history at predict
+    # time.  The cold_cache arm isolates what retrieval cannot do:
+    # unseen parameter points against an EMPTY WarmStartIndex (exactly
+    # the post-restart cache) where k-NN scores 0 hits and only the
+    # regressed start can cut iterations.  Both arms cross-check
+    # objectives against the serial HiGHS baseline -------------------
+    try:
+        from dispatches_tpu.learn import (OnlineTrainer, snap_to_bounds)
+        from dispatches_tpu.learn import fit as learn_fit
+        from dispatches_tpu.serve.warmstart import WarmStartIndex
+        from dispatches_tpu.solvers.pdlp import (START_EXACT,
+                                                 START_PREDICTED)
+
+        pr_train_n = 192   # 24 batches through the warmstart program
+        pr_hidden = 128    # wider than serve's default: the bench
+        pr_window = 24     # chain is short, so variance is cheap and
+        #                    capacity wins; the window tracks the tube
+        pr_spec = TrafficSpec(perturb=("lmp",), rho=ws_rho,
+                              sigma=ws_sigma, seed=7)
+        pr_stream = perturbed_params(pr_spec, ws_base, pr_train_n)
+        pr_lmps = np.stack([np.asarray(s["p"]["lmp"])
+                            for s in pr_stream])  # $/kWh, the vec space
+        pr_cf = np.repeat(cfs[:1], ws_lanes, axis=0)
+        train_x = np.zeros((pr_train_n, n_ws), np.float32)
+        train_z = np.zeros((pr_train_n, m_ws), np.float32)
+        for b in range(pr_train_n // ws_lanes):
+            sl = slice(b * ws_lanes, (b + 1) * ws_lanes)
+            batch = {"p": {**params["p"], "lmp": jnp.asarray(pr_lmps[sl]),
+                           "windpower.capacity_factor": jnp.asarray(pr_cf)},
+                     "fixed": params["fixed"]}
+            r = ws_vsolve(batch, ws_zero)
+            train_x[sl] = np.asarray(r.x)
+            train_z[sl] = np.asarray(r.z)
+        pr_lb = np.asarray(lp_ws["lb"], np.float32)
+        pr_ub = np.asarray(lp_ws["ub"], np.float32)
+
+        def _pred_start(pred, lmp_rows):
+            pairs = [pred.predict(np.asarray(v, np.float32))
+                     for v in lmp_rows]
+            return (jnp.asarray(np.stack(
+                        [snap_to_bounds(x, pr_lb, pr_ub) for x, _ in pairs])),
+                    jnp.asarray(np.stack([z for _, z in pairs])),
+                    jnp.full((len(pairs),), START_PREDICTED, jnp.int32))
+
+        # drift arm: the warmstart section's stream and cold baseline,
+        # replayed through the serve ladder — exact rung for repeat
+        # lanes, the online-refit predictor for drift lanes.  The
+        # trainer adopts an offline fit of the first half of the
+        # micro-sweep (ResultStore.training_pairs in miniature), seeds
+        # its replay buffer with those same completed results, then
+        # refits on the recency window as traffic lands.
+        trainer = OnlineTrainer(n_ws, m_ws, hidden=pr_hidden,
+                                refit_every=ws_lanes)
+        half = pr_train_n // 2
+        trainer.adopt(learn_fit(pr_lmps[:half].astype(np.float32),
+                                train_x[:half], train_z[:half],
+                                hidden=pr_hidden, epochs=800), half)
+        for i in range(half):
+            trainer.observe(pr_lmps[i], train_x[i], train_z[i])
+        pred_iters = np.zeros((ws_steps, ws_lanes))
+        pred_objs = np.zeros((ws_steps, ws_lanes))
+        pr_prev = None
+        for t in range(ws_steps):
+            rows = [_ws_lmp(l, t) for l in range(ws_lanes)]
+            px, pz, pk = _pred_start(trainer.predictor, rows)
+            if pr_prev is not None:  # exact rung for the repeat lanes
+                rep = np.arange(ws_drift, ws_lanes)
+                px = px.at[rep].set(jnp.asarray(pr_prev.x)[rep])
+                pz = pz.at[rep].set(jnp.asarray(pr_prev.z)[rep])
+                pk = pk.at[rep].set(START_EXACT)
+            r = ws_vsolve(ws_batches[t], (px, pz, pk))
+            pred_iters[t] = np.asarray(r.iters)
+            pred_objs[t] = np.asarray(r.obj)
+            pr_prev = r
+            for l in range(ws_lanes):
+                trainer.observe(rows[l], np.asarray(r.x)[l],
+                                np.asarray(r.z)[l])
+            if trainer.due():
+                trainer.refit(window=pr_window, epochs=2000, lr=1e-3)
+        # steps >= 1 only: same denominator as pdhg_iters_warm_ratio
+        pred_ratio = (float(np.mean(pred_iters[1:]))
+                      / max(float(np.mean(cold_iters[1:])), 1.0))
+
+        # cold-cache arm: a fresh (empty) index — the cache a restarted
+        # service wakes up with — queried per point to pin knn_hits=0.
+        # The predictor here is the offline fit of the FULL micro-sweep
+        # (no stream observed yet): restore-from-snapshot semantics.
+        cc_model = learn_fit(pr_lmps.astype(np.float32), train_x, train_z,
+                             hidden=pr_hidden, epochs=800)
+        cc_n = 2 * ws_lanes
+        cc_spec = TrafficSpec(perturb=("lmp",), rho=ws_rho,
+                              sigma=ws_sigma, seed=1234)
+        cc_stream = perturbed_params(cc_spec, ws_base, cc_n)
+        cc_lmps = np.stack([np.asarray(s["p"]["lmp"]) for s in cc_stream])
+        cc_index = WarmStartIndex()
+        cc_knn_hits = sum(
+            1 for v in cc_lmps
+            if cc_index.nearest(np.asarray(v, np.float64)) is not None)
+        cc_cold_iters = np.zeros((2, ws_lanes))
+        cc_cold_objs = np.zeros((2, ws_lanes))
+        cc_pred_iters = np.zeros((2, ws_lanes))
+        cc_pred_objs = np.zeros((2, ws_lanes))
+        for b in range(2):
+            sl = slice(b * ws_lanes, (b + 1) * ws_lanes)
+            batch = {"p": {**params["p"], "lmp": jnp.asarray(cc_lmps[sl]),
+                           "windpower.capacity_factor": jnp.asarray(pr_cf)},
+                     "fixed": params["fixed"]}
+            r = ws_vsolve(batch, ws_zero)
+            cc_cold_iters[b] = np.asarray(r.iters)
+            cc_cold_objs[b] = np.asarray(r.obj)
+            r = ws_vsolve(batch, _pred_start(cc_model, list(cc_lmps[sl])))
+            cc_pred_iters[b] = np.asarray(r.iters)
+            cc_pred_objs[b] = np.asarray(r.obj)
+        _, cc_refs = _serial_highs_baseline(cc_lmps * 1e3,
+                                            np.repeat(cfs[:1], cc_n, axis=0),
+                                            cc_n)
+        cc_refs = np.asarray(cc_refs).reshape(2, ws_lanes)
+
+        def _cc_err(objs):
+            return float(np.max(np.abs(objs - cc_refs)
+                                / np.maximum(np.abs(cc_refs), 1.0)))
+
+        cc_cut = (float(np.mean(cc_cold_iters))
+                  / max(float(np.mean(cc_pred_iters)), 1.0))
+        out["predict"] = {
+            "lanes": ws_lanes,
+            "steps": ws_steps,
+            "rho": ws_rho,
+            "sigma": ws_sigma,
+            "train_points": pr_train_n,
+            "hidden": pr_hidden,
+            "window": pr_window,
+            "refit_every": ws_lanes,
+            "pdhg_iters_cold_mean": round(float(np.mean(cold_iters[1:])), 1),
+            "pdhg_iters_pred_mean": round(float(np.mean(pred_iters[1:])), 1),
+            "pdhg_iters_pred_ratio": round(pred_ratio, 4),
+            "obj_rel_err_cold": round(_ws_err(cold_objs), 8),
+            "obj_rel_err_pred": round(_ws_err(pred_objs), 8),
+            "cold_cache": {
+                "points": cc_n,
+                "knn_hits": cc_knn_hits,
+                "pdhg_iters_cold_mean":
+                    round(float(np.mean(cc_cold_iters)), 1),
+                "pdhg_iters_pred_mean":
+                    round(float(np.mean(cc_pred_iters)), 1),
+                "iters_cut": round(cc_cut, 4),
+                "obj_rel_err_cold": round(_cc_err(cc_cold_objs), 8),
+                "obj_rel_err_pred": round(_cc_err(cc_pred_objs), 8),
+            },
+        }
+    except Exception as exc:  # telemetry must never kill the headline
+        out["predict_bench_error"] = str(exc)[:120]
 
     # ---- chaos-soak A/B (ISSUE 13): the same virtual stub replay
     # clean and with a fault scenario armed over a mid-replay window —

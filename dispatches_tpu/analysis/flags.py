@@ -173,6 +173,17 @@ REGISTERED_FLAGS = {
     "rounds exchanging warm-start index entries and admission "
     "service-time estimates between replicas "
     "(fleet.FleetOptions.from_env; default 5)",
+    "WARMSTART_PREDICT": "kill-switch for the learned warm-start "
+    "predictor rung — ON by default when warm starts are on; set to "
+    "0/false to drop straight to k-NN retrieval with no predictor "
+    "constructed (learn.predictor.predict_enabled; read at "
+    "bucket-build time)",
+    "WARMSTART_PREDICT_HIDDEN": "hidden-layer width of the warm-start "
+    "predictor MLP head (learn.predictor.default_hidden; default 32)",
+    "WARMSTART_PREDICT_REFIT_N": "completed warm-bucket results "
+    "between online predictor refits, ticked from SolveService.poll "
+    "— never the submit hot path (learn.train.default_refit_every; "
+    "default 64)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -146,6 +146,7 @@ CORPUS: Dict[str, Dict[str, str]] = {
             exp = os.environ.get("DISPATCHES_TPU_OBS_EXPORT")
             soak = os.environ.get("DISPATCHES_TPU_SOAK_SPEC_PATH")
             cool = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN")
+            pred = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_N")
         """,
         "good": """
             import os
@@ -188,6 +189,9 @@ CORPUS: Dict[str, Dict[str, str]] = {
             freps = os.environ.get("DISPATCHES_TPU_FLEET_REPLICAS")
             fhb = os.environ.get("DISPATCHES_TPU_FLEET_HEARTBEAT_MS")
             fgos = os.environ.get("DISPATCHES_TPU_FLEET_GOSSIP_INTERVAL_S")
+            wpred = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT")
+            wphid = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_HIDDEN")
+            wpref = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_REFIT_N")
         """,
     },
     "GL008": {

@@ -19,6 +19,11 @@ Merging is additive and conservative:
   samples of its own (cold adoption, never averaging — a replica's
   admission policy must stay calibrated to its own hardware once it
   has evidence);
+* warm-start predictor weights are adopted most-trained-wins: a donor
+  whose trainer has seen strictly more samples replaces the
+  recipient's fit wholesale (never averaged — weights fitted on
+  different replay windows do not mix), so replicas converge on the
+  fleet's best-trained model;
 * a recipient that has not built the donor's bucket yet stashes the
   state in ``service._restored_buckets`` under the bucket label —
   exactly the snapshot-restore path — and ``_bucket_for`` applies it
@@ -123,7 +128,34 @@ class Gossip:
                 snapshot_mod._restore_p2(est._p95, est_state["p2"])
             except Exception:
                 pass
+        self._merge_predictor(bucket, state.get("predictor"))
         return adopted
+
+    @staticmethod
+    def _merge_predictor(bucket, pred_state) -> None:
+        """Most-trained-wins predictor adoption: a replica takes the
+        donor's fitted weights only when the donor has seen strictly
+        more training samples — replicas serving the same stream
+        converge on the best-trained model without averaging (weights
+        fitted on different replay windows do not mix)."""
+        trainer = getattr(bucket, "predict_trainer", None)
+        if (trainer is None or pred_state is None
+                or getattr(bucket, "predict_fallback", False)):
+            return
+        try:
+            donated = journal_mod.decode_tree(pred_state)
+            donor_trained = int(donated.get("trained_samples", 0))
+            if donor_trained <= trainer.trained_samples:
+                return
+            from dispatches_tpu.learn.predictor import StartPredictor
+
+            pred = StartPredictor.from_state(donated.get("predictor"))
+            if pred is None:
+                return
+            trainer.adopt(pred, donor_trained)
+            bucket.predict_weights = dict(pred.params)
+        except Exception:
+            return  # a malformed donation must never take a replica down
 
     @staticmethod
     def _merge_index(bucket, index_state) -> int:

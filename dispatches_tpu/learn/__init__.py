@@ -1,0 +1,45 @@
+"""Learned warm starts: predict primal–dual starts by regression
+instead of retrieving them from neighbors.
+
+* :mod:`dispatches_tpu.learn.predictor` — the pure-JAX MLP head
+  (``forward`` stages through an ExecutionPlan program; weights are
+  arguments, so online refits never recompile).
+* :mod:`dispatches_tpu.learn.train` — full-batch Adam fitting from the
+  sweep store or the live warm index, plus the serve-side
+  :class:`OnlineTrainer` (bounded replay buffer, poll-clock refits).
+
+See ``docs/learn.md`` for the model, training sources, refit policy,
+and how weights ride PR-15 snapshots and fleet gossip.
+"""
+
+from dispatches_tpu.learn.predictor import (  # noqa: F401
+    StartPredictor,
+    default_hidden,
+    forward,
+    init_params,
+    predict_enabled,
+    snap_to_bounds,
+)
+from dispatches_tpu.learn.train import (  # noqa: F401
+    OnlineTrainer,
+    ReplayBuffer,
+    default_refit_every,
+    fit,
+    fit_from_index,
+    fit_from_store,
+)
+
+__all__ = [
+    "OnlineTrainer",
+    "ReplayBuffer",
+    "StartPredictor",
+    "default_hidden",
+    "default_refit_every",
+    "fit",
+    "fit_from_index",
+    "fit_from_store",
+    "forward",
+    "init_params",
+    "predict_enabled",
+    "snap_to_bounds",
+]

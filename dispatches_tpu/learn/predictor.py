@@ -1,0 +1,178 @@
+"""Learned warm-start predictor: a small pure-JAX MLP head mapping the
+canonical ``param_vector`` to a scaled-space primal–dual start.
+
+k-NN retrieval (``serve/warmstart.py``) can only hand back starts for
+parameter points a neighbor has already visited; this module turns warm
+starts from retrieval into *inference* so serve can start well on points
+nobody has seen.  The model is deliberately tiny:
+
+    vn  = (vec - in_mean) / in_scale               # normalized input
+    out = vn @ w_lin + tanh(vn @ w1 + b1) @ w2 + b2
+    y   = out * out_scale + out_mean               # (n + m,) start
+
+The residual linear path ``w_lin`` carries the bulk of the map — LP
+primal–dual solutions are piecewise-linear in the objective vector, so a
+linear head plus a small tanh correction fits the AR(1) bench streams
+with a few hundred full-batch Adam steps (``learn/train.py``).  The
+first ``n`` outputs are the scaled-space primal ``x0`` and the rest the
+original-space dual ``z0`` — exactly the spaces of the PDLP start
+contract and of what :class:`~dispatches_tpu.serve.warmstart.WarmStartIndex`
+stores.
+
+:func:`forward` is a pure function of ``(params, vec)`` so serve can
+stage it through the :class:`~dispatches_tpu.plan.ExecutionPlan` as a
+batched per-bucket program (weights are *arguments*, not captured
+constants: online refits never recompile the program).  Parameters live
+in one flat dict of arrays — plain-codec friendly for PR-15 snapshots
+and fleet gossip.
+
+Flags (registered in ``analysis.flags``; GL006):
+
+* ``DISPATCHES_TPU_WARMSTART_PREDICT`` — kill-switch.  Prediction is ON
+  by default whenever warm starts are on; set to ``0``/``false`` and no
+  predictor/trainer is even constructed (the ladder is bitwise the
+  PR-12 retrieval path).
+* ``DISPATCHES_TPU_WARMSTART_PREDICT_HIDDEN`` — MLP hidden width.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+
+__all__ = [
+    "StartPredictor",
+    "default_hidden",
+    "forward",
+    "init_params",
+    "predict_enabled",
+    "snap_to_bounds",
+]
+
+DEFAULT_HIDDEN = 32
+
+# trainable keys, in the fixed order the trainer's Adam state mirrors
+PARAM_KEYS = ("w_lin", "w1", "b1", "w2", "b2")
+# frozen normalization constants riding the same dict
+NORM_KEYS = ("in_mean", "in_scale", "out_mean", "out_scale")
+
+
+def predict_enabled() -> bool:
+    """Kill-switch: the predictor rung is ON unless
+    ``DISPATCHES_TPU_WARMSTART_PREDICT`` is set to an explicit falsy
+    value (same falsy vocabulary as ``flags.flag_enabled``)."""
+    raw = os.environ.get(flag_name("WARMSTART_PREDICT"))
+    if raw is None:
+        return True
+    return raw not in ("", "0", "false", "False")
+
+
+def default_hidden() -> int:
+    raw = os.environ.get(flag_name("WARMSTART_PREDICT_HIDDEN"), "")
+    return int(raw) if raw else DEFAULT_HIDDEN
+
+
+def init_params(d: int, n: int, m: int, hidden: int,
+                seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic initial parameter dict (host numpy, float32).
+
+    ``w_lin``/``w2``/``b2`` start at zero so the untrained model
+    predicts ``out_mean`` — the mean solution, a sane start — and the
+    tanh path only grows weight once training pushes it there.
+    Normalization starts at identity; :func:`learn.train.fit` sets it
+    from data before the first step.
+    """
+    rng = np.random.default_rng(seed)
+    s = np.sqrt(2.0 / max(d, 1))
+    return {
+        "w_lin": np.zeros((d, n + m), np.float32),
+        "w1": (s * rng.standard_normal((d, hidden))).astype(np.float32),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": np.zeros((hidden, n + m), np.float32),
+        "b2": np.zeros(n + m, np.float32),
+        "in_mean": np.zeros(d, np.float32),
+        "in_scale": np.ones(d, np.float32),
+        "out_mean": np.zeros(n + m, np.float32),
+        "out_scale": np.ones(n + m, np.float32),
+    }
+
+
+def forward(params: Dict, vec):
+    """Predicted ``(n + m,)`` start for one parameter vector.
+
+    Pure and jit/vmap-safe; ``params`` is a pytree argument so a
+    compiled program keeps serving across online refits.  The caller
+    splits the output at its (static) primal size ``n``.
+    """
+    import jax.numpy as jnp
+
+    vn = (vec - params["in_mean"]) / params["in_scale"]
+    out = vn @ params["w_lin"] + \
+        jnp.tanh(vn @ params["w1"] + params["b1"]) @ params["w2"] + \
+        params["b2"]
+    return out * params["out_scale"] + params["out_mean"]
+
+
+def snap_to_bounds(x, lb, ub, eps: float = 1e-3):
+    """Snap a predicted primal start onto finite variable bounds it
+    nearly touches (within ``eps`` relative), then clip into the box.
+
+    LP solutions sit at vertices: most primal coordinates are exactly
+    *at* a bound, and a regression head lands ``eps``-close instead.
+    Snapping restores the active-set structure the PDHG iteration
+    locks onto quickly.  Primal only — never snap or otherwise round a
+    predicted dual; small structured dual errors are benign but
+    truncating duals against their sign constraints is catastrophic
+    (the solver's own ingestion handles the sign split).
+    """
+    x = np.asarray(x, np.float32)
+    lb = np.asarray(lb, np.float32)
+    ub = np.asarray(ub, np.float32)
+    tol_lb = eps * (1.0 + np.abs(lb))
+    tol_ub = eps * (1.0 + np.abs(ub))
+    x = np.where(np.isfinite(lb) & (np.abs(x - lb) < tol_lb), lb, x)
+    x = np.where(np.isfinite(ub) & (np.abs(x - ub) < tol_ub), ub, x)
+    return np.clip(x, lb, ub)
+
+
+class StartPredictor:
+    """A fitted predictor: parameter dict plus the (d, n, m) shape
+    contract.  Construction is cheap — the trainer builds one per refit
+    and serve just swaps which dict the staged program receives."""
+
+    def __init__(self, params: Dict[str, np.ndarray], n: int, m: int):
+        self.params = params
+        self.n = int(n)
+        self.m = int(m)
+        self.d = int(np.asarray(params["w1"]).shape[0])
+        self.hidden = int(np.asarray(params["w1"]).shape[1])
+
+    def predict(self, vec) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side single-point prediction ``(x0, z0)`` — for tests
+        and offline use; serve runs :func:`forward` batched on device."""
+        p = self.params
+        vn = (np.asarray(vec, np.float32).ravel() - p["in_mean"]) \
+            / p["in_scale"]
+        out = vn @ p["w_lin"] + \
+            np.tanh(vn @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+        y = out * p["out_scale"] + p["out_mean"]
+        return y[: self.n].copy(), y[self.n:].copy()
+
+    def to_state(self) -> dict:
+        return {
+            "n": self.n,
+            "m": self.m,
+            "params": {k: np.asarray(v) for k, v in self.params.items()},
+        }
+
+    @classmethod
+    def from_state(cls, state: Optional[dict]) -> Optional["StartPredictor"]:
+        if state is None:
+            return None
+        params = {k: np.asarray(v, np.float32)
+                  for k, v in state["params"].items()}
+        return cls(params, int(state["n"]), int(state["m"]))

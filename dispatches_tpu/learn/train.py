@@ -1,0 +1,328 @@
+"""Fitting the warm-start predictor: offline from stored sweep
+solutions, online from serve's own completed results.
+
+Two data sources feed the same :func:`fit`:
+
+* :func:`fit_from_store` — a warm-start sweep's
+  :meth:`~dispatches_tpu.sweep.store.ResultStore.training_pairs`
+  (finite, non-quarantined ``(inputs, x, z)`` rows).
+* :func:`fit_from_index` — a serve bucket's live
+  :meth:`~dispatches_tpu.serve.warmstart.WarmStartIndex.export_pairs`.
+
+Training is full-batch Adam on the MSE of *normalized* outputs, run as
+one jitted ``lax.fori_loop`` (a few hundred steps over a few thousand
+rows — milliseconds on any backend).  Rows are padded to the next power
+of two with zero sample weight so refits at different buffer fills
+reuse a handful of compiled shapes instead of recompiling per call.
+
+:class:`OnlineTrainer` is the serve-side wrapper: ``observe()`` is a
+cheap bounded-replay-buffer append called from the completion path,
+``due()`` an O(1) cadence check, and ``refit()`` — the only expensive
+call — runs from ``SolveService.poll`` on the service clock, never on
+the submit hot path.  The replay buffer is deliberately transient:
+snapshots and gossip carry the fitted weights plus training counters
+(``to_state``/``load_state``), and a restored service simply resumes
+accumulating fresh results toward its next refit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dispatches_tpu.analysis.flags import flag_name
+from dispatches_tpu.learn.predictor import (
+    NORM_KEYS,
+    PARAM_KEYS,
+    StartPredictor,
+    default_hidden,
+    init_params,
+)
+
+__all__ = [
+    "OnlineTrainer",
+    "ReplayBuffer",
+    "default_refit_every",
+    "fit",
+    "fit_from_index",
+    "fit_from_store",
+]
+
+DEFAULT_REFIT_EVERY = 64
+DEFAULT_REPLAY_CAPACITY = 2048
+DEFAULT_EPOCHS = 300
+DEFAULT_LR = 3e-3
+MIN_FIT_POINTS = 8
+
+# (d, out_dim, hidden, rows, epochs, lr) -> jitted training loop
+_FIT_CACHE: dict = {}
+
+
+def default_refit_every() -> int:
+    raw = os.environ.get(flag_name("WARMSTART_PREDICT_REFIT_N"), "")
+    return int(raw) if raw else DEFAULT_REFIT_EVERY
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _fit_loop(key):
+    """Build (and cache) the jitted Adam loop for one padded shape."""
+    import jax
+    import jax.numpy as jnp
+
+    d, out_dim, hidden, rows, epochs, lr = key
+    del d, hidden, rows  # shape info rides the traced arrays
+
+    def loss_fn(tr, norm, X, Yn, w):
+        vn = (X - norm["in_mean"]) / norm["in_scale"]
+        pred = vn @ tr["w_lin"] + \
+            jnp.tanh(vn @ tr["w1"] + tr["b1"]) @ tr["w2"] + tr["b2"]
+        err = pred - Yn
+        return jnp.sum(w[:, None] * err * err) / (jnp.sum(w) * out_dim)
+
+    grad_fn = jax.grad(loss_fn)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    def run(tr, norm, X, Yn, w):
+        mom = jax.tree_util.tree_map(jnp.zeros_like, tr)
+        vel = jax.tree_util.tree_map(jnp.zeros_like, tr)
+
+        def step(i, carry):
+            tr, mom, vel = carry
+            g = grad_fn(tr, norm, X, Yn, w)
+            t = (i + 1).astype(jnp.float32)
+            mom = jax.tree_util.tree_map(
+                lambda m_, g_: b1 * m_ + (1.0 - b1) * g_, mom, g)
+            vel = jax.tree_util.tree_map(
+                lambda v_, g_: b2 * v_ + (1.0 - b2) * g_ * g_, vel, g)
+            c1 = 1.0 - jnp.power(b1, t)
+            c2 = 1.0 - jnp.power(b2, t)
+            tr = jax.tree_util.tree_map(
+                lambda p_, m_, v_: p_ - lr * (m_ / c1) /
+                (jnp.sqrt(v_ / c2) + eps),
+                tr, mom, vel)
+            return tr, mom, vel
+
+        tr, mom, vel = jax.lax.fori_loop(0, epochs, step, (tr, mom, vel))
+        return tr
+
+    return jax.jit(run)
+
+
+def fit(vecs, xs, zs, *, hidden: Optional[int] = None, seed: int = 0,
+        epochs: int = DEFAULT_EPOCHS, lr: float = DEFAULT_LR
+        ) -> StartPredictor:
+    """Fit a :class:`StartPredictor` on ``(vec, x, z)`` training triples.
+
+    ``vecs`` is (N, d) parameter vectors; ``xs``/``zs`` the matching
+    scaled-space primal and original-space dual solutions (any trailing
+    shape, flattened per row).  Non-finite rows are dropped — a
+    diverged solve must never steer the predictor.  Deterministic for
+    fixed inputs/seed.
+    """
+    import jax.numpy as jnp
+
+    vecs = np.atleast_2d(np.asarray(vecs, np.float32))
+    N = vecs.shape[0]
+    xs = np.asarray(xs, np.float32).reshape(N, -1)
+    zs = np.asarray(zs, np.float32).reshape(N, -1)
+    Y = np.concatenate([xs, zs], axis=1)
+    keep = np.all(np.isfinite(vecs), axis=1) & np.all(np.isfinite(Y), axis=1)
+    vecs, Y = vecs[keep], Y[keep]
+    N = vecs.shape[0]
+    if N < 1:
+        raise ValueError("fit needs at least one finite training row")
+    n, m = xs.shape[1], zs.shape[1]
+    d = vecs.shape[1]
+    hidden = default_hidden() if hidden is None else int(hidden)
+
+    params = init_params(d, n, m, hidden, seed)
+    params["in_mean"] = vecs.mean(axis=0)
+    params["in_scale"] = np.maximum(vecs.std(axis=0), 1e-6).astype(np.float32)
+    params["out_mean"] = Y.mean(axis=0)
+    params["out_scale"] = np.maximum(Y.std(axis=0), 1e-6).astype(np.float32)
+    Yn = (Y - params["out_mean"]) / params["out_scale"]
+
+    rows = _next_pow2(N)
+    Xp = np.zeros((rows, d), np.float32)
+    Ynp = np.zeros((rows, n + m), np.float32)
+    w = np.zeros(rows, np.float32)
+    Xp[:N], Ynp[:N], w[:N] = vecs, Yn, 1.0
+
+    key = (d, n + m, hidden, rows, int(epochs), float(lr))
+    run = _FIT_CACHE.get(key)
+    if run is None:
+        run = _FIT_CACHE[key] = _fit_loop(key)
+    tr = {k: jnp.asarray(params[k]) for k in PARAM_KEYS}
+    norm = {k: jnp.asarray(params[k]) for k in NORM_KEYS}
+    tr = run(tr, norm, Xp, Ynp, w)
+    params.update({k: np.asarray(v) for k, v in tr.items()})
+    return StartPredictor(params, n, m)
+
+
+def fit_from_store(store, **kwargs) -> StartPredictor:
+    """Offline fit from a warm-start sweep's saved solutions
+    (:meth:`ResultStore.training_pairs`; raises on a store swept
+    without ``warm_start``)."""
+    vecs, xs, zs = store.training_pairs()
+    return fit(vecs, xs, zs, **kwargs)
+
+
+def fit_from_index(index, **kwargs) -> StartPredictor:
+    """Offline fit from a live :class:`WarmStartIndex`
+    (:meth:`export_pairs`; raises on an empty index)."""
+    vecs, xs, zs = index.export_pairs()
+    if len(vecs) == 0:
+        raise ValueError("fit_from_index: the index is empty")
+    return fit(np.stack(vecs), np.stack(xs), np.stack(zs), **kwargs)
+
+
+class ReplayBuffer:
+    """Bounded ring of (vec, x, z) training triples, oldest evicted
+    first.  Same defensive non-finite drop as the warm index; arrays
+    come back in logical insertion order so a refit is deterministic
+    for a given observation history."""
+
+    def __init__(self, capacity: int = DEFAULT_REPLAY_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._vecs: Optional[np.ndarray] = None
+        self._xs: Optional[np.ndarray] = None
+        self._zs: Optional[np.ndarray] = None
+        self._cursor = 0
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def append(self, vec, x, z) -> None:
+        vec = np.asarray(vec, np.float32).ravel()
+        x = np.asarray(x, np.float32).ravel()
+        z = np.asarray(z, np.float32).ravel()
+        if not (np.all(np.isfinite(vec)) and np.all(np.isfinite(x))
+                and np.all(np.isfinite(z))):
+            return
+        if self._vecs is None:
+            self._vecs = np.zeros((self.capacity, vec.size), np.float32)
+            self._xs = np.zeros((self.capacity, x.size), np.float32)
+            self._zs = np.zeros((self.capacity, z.size), np.float32)
+        slot = self._cursor
+        self._vecs[slot], self._xs[slot], self._zs[slot] = vec, x, z
+        self._cursor = (self._cursor + 1) % self.capacity
+        self._count = min(self._count + 1, self.capacity)
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self._count < self.capacity:
+            order = np.arange(self._count)
+        else:
+            order = (self._cursor + np.arange(self.capacity)) % self.capacity
+        return self._vecs[order], self._xs[order], self._zs[order]
+
+
+class OnlineTrainer:
+    """Serve-side predictor lifecycle: cheap observation, O(1) cadence
+    check, clock-driven refit, codec-friendly state.
+
+    ``trained_samples`` — total observations seen at the last refit —
+    is the gossip merge key: the most-trained replica's weights win.
+    """
+
+    def __init__(self, n: int, m: int, *, hidden: Optional[int] = None,
+                 refit_every: Optional[int] = None,
+                 capacity: int = DEFAULT_REPLAY_CAPACITY,
+                 min_points: int = MIN_FIT_POINTS, seed: int = 0):
+        self.n = int(n)
+        self.m = int(m)
+        self.hidden = default_hidden() if hidden is None else int(hidden)
+        self.refit_every = (default_refit_every() if refit_every is None
+                            else int(refit_every))
+        self.min_points = int(min_points)
+        self.seed = int(seed)
+        self.buffer = ReplayBuffer(capacity)
+        self.predictor: Optional[StartPredictor] = None
+        self.samples = 0          # total ever observed
+        self.trained_samples = 0  # samples at last refit/adoption
+        self.refits = 0
+        self._pending = 0
+
+    def observe(self, vec, x, z) -> None:
+        """One completed result (converged + finite, caller-gated).
+        O(capacity-row copy); safe on the completion path."""
+        self.buffer.append(vec, x, z)
+        self.samples += 1
+        self._pending += 1
+
+    def due(self) -> bool:
+        """O(1): enough fresh results since the last refit?"""
+        return (self._pending >= self.refit_every
+                and len(self.buffer) >= self.min_points)
+
+    def ready(self) -> bool:
+        return self.predictor is not None
+
+    def refit(self, *, epochs: int = DEFAULT_EPOCHS,
+              lr: float = DEFAULT_LR,
+              window: Optional[int] = None) -> StartPredictor:
+        """Full refit from the replay buffer (the expensive call —
+        ``SolveService.poll`` gates it behind :meth:`due`).
+
+        ``window`` restricts the fit to the most recent rows.  On a
+        drifting stream the solution map's active pieces migrate with
+        the traffic, so a small recency window tracks the tube the next
+        requests will land in better than the whole buffer; on
+        stationary traffic leave it ``None`` (all rows) for the lowest
+        variance.  The window never shrinks below ``min_points``.
+        """
+        vecs, xs, zs = self.buffer.arrays()
+        if window is not None:
+            tail = max(int(window), self.min_points)
+            vecs, xs, zs = vecs[-tail:], xs[-tail:], zs[-tail:]
+        self.predictor = fit(vecs, xs, zs, hidden=self.hidden,
+                             seed=self.seed, epochs=epochs, lr=lr)
+        self._pending = 0
+        self.refits += 1
+        self.trained_samples = self.samples
+        return self.predictor
+
+    def adopt(self, predictor: StartPredictor, trained_samples: int) -> None:
+        """Take over a predictor fitted elsewhere (offline store fit,
+        or a better-trained gossip peer).  Shape-checked: a bucket
+        never mixes problem sizes."""
+        if (predictor.n, predictor.m) != (self.n, self.m):
+            raise ValueError(
+                f"predictor shape ({predictor.n}, {predictor.m}) does not "
+                f"match trainer ({self.n}, {self.m})"
+            )
+        self.predictor = predictor
+        self.trained_samples = int(trained_samples)
+
+    def to_state(self) -> dict:
+        """Weights + counters; the replay buffer is transient by
+        design (a restored service re-accumulates fresh results)."""
+        return {
+            "n": self.n,
+            "m": self.m,
+            "hidden": self.hidden,
+            "refit_every": self.refit_every,
+            "samples": self.samples,
+            "trained_samples": self.trained_samples,
+            "refits": self.refits,
+            "predictor": None if self.predictor is None
+            else self.predictor.to_state(),
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.samples = int(state.get("samples", 0))
+        self.trained_samples = int(state.get("trained_samples", 0))
+        self.refits = int(state.get("refits", 0))
+        pred = StartPredictor.from_state(state.get("predictor"))
+        if pred is not None and (pred.n, pred.m) == (self.n, self.m):
+            self.predictor = pred

@@ -111,6 +111,12 @@ GATED_METRICS = {
     # means cross-request warm starts stopped paying (the accuracy side
     # is covered by the arms' obj_rel_err cross-check in the section)
     "pdhg_iters_warm_ratio": -1,
+    # bench predict section (ISSUE 18): predicted/cold mean PDHG
+    # iterations on the AR(1) drift arm — the learned predictor must
+    # keep beating both cold starts and the retrieval ratio above; a
+    # rise means the regression head stopped generalizing (accuracy is
+    # cross-checked by the section's obj_rel_err fields)
+    "pdhg_iters_pred_ratio": -1,
     # bench chaos section (ISSUE 13): recovered/injected over the
     # faults-armed virtual replay — any drop below 1.0 means an
     # injected fault escaped the retry/bisection/no-hang machinery —

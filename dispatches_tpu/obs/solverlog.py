@@ -38,7 +38,7 @@ __all__ = [
 
 # mirrors solvers.pdlp.START_KIND_NAMES (not imported: that module
 # pulls jax, and this one must stay NumPy-only for the obs CLI)
-_START_KIND_NAMES = ("cold", "exact", "neighbor")
+_START_KIND_NAMES = ("cold", "exact", "neighbor", "predicted")
 
 
 @dataclass
@@ -49,9 +49,10 @@ class ConvergenceTrace:
     solver: str
     iterations: int
     columns: Dict[str, np.ndarray] = field(default_factory=dict)
-    # how the lane's iterate was seeded ("cold" | "exact" | "neighbor")
-    # — a warm-started tail reads very differently from a cold one
-    # (e.g. near-zero err at row 0), so the bundle must say which it is
+    # how the lane's iterate was seeded ("cold" | "exact" | "neighbor"
+    # | "predicted") — a warm-started tail reads very differently from
+    # a cold one (e.g. near-zero err at row 0), so the bundle must say
+    # which it is
     start_kind: Optional[str] = None
 
     def __len__(self) -> int:

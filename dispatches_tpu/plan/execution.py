@@ -237,7 +237,8 @@ class PlanProgram:
     over an optionally vmapped kernel, plus its donation contract.
 
     Built via :meth:`ExecutionPlan.program`; called only through
-    :meth:`ExecutionPlan.submit`.  ``_graft_counter`` is the PR-1
+    :meth:`ExecutionPlan.submit` (or, for head programs feeding a
+    submit, :meth:`ExecutionPlan.run_inline`).  ``_graft_counter`` is the PR-1
     recompile-accounting counter (``assert_no_recompiles`` /
     ``metrics()['compile_count']`` keep working unchanged).
     """
@@ -595,6 +596,26 @@ class ExecutionPlan:
             donate_argnums = tuple(range(n_args)) if donate else ()
         return PlanProgram(self, fn, label=label, vmap_axes=vmap_axes,
                            donate_argnums=donate_argnums)
+
+    def run_inline(self, program: PlanProgram, args: Tuple):
+        """Dispatch one auxiliary program asynchronously with NO window
+        entry: no ticket, no fence bookkeeping, no in-flight slot.
+
+        For head programs whose outputs feed straight into a
+        :meth:`submit` as that batch's staged inputs (serve's
+        per-bucket warm-start predictor is the canonical case): the
+        device arrays returned here are futures, the downstream batch
+        consumes them on device, and its fence covers both — so the
+        head costs zero extra host round-trips.  Not for standalone
+        work: nothing fences these outputs except their consumer."""
+        tracing = obs_trace.enabled()
+        t0_us = obs_trace.now_us() if tracing else 0.0
+        out = program._run(*args)
+        if tracing:
+            obs_trace.complete("plan.inline", t0_us,
+                               obs_trace.now_us() - t0_us,
+                               plan=self.plan_id, label=program.label)
+        return out
 
     # -- dispatch pipeline -------------------------------------------------
 

@@ -215,10 +215,12 @@ def format_stats(metrics: Dict) -> str:
         )
     ws = metrics["warm_start"]
     lines.append(
-        "warm starts: {hits} exact hit(s) / {nb} neighbor hit(s) / "
-        "{misses} miss(es), hit rate {rate:.4f}, "
+        "warm starts: {hits} exact hit(s) / {pred} predicted / "
+        "{nb} neighbor hit(s) / {misses} miss(es), "
+        "hit rate {rate:.4f}, "
         "{size} cached solution(s), {mp} mispredict(s)".format(
-            hits=ws["hits"], nb=ws.get("neighbor_hits", 0),
+            hits=ws["hits"], pred=ws.get("predicted", 0),
+            nb=ws.get("neighbor_hits", 0),
             misses=ws["misses"], rate=ws.get("hit_rate", 0.0),
             size=ws["size"], mp=ws.get("mispredicts", 0))
     )

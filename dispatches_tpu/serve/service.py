@@ -117,6 +117,8 @@ from dispatches_tpu.serve.metrics import (
     format_stats,
 )
 from dispatches_tpu.serve import warmstart
+from dispatches_tpu.learn import predictor as learn_predictor
+from dispatches_tpu.learn import train as learn_train
 from dispatches_tpu.plan import ExecutionPlan, PlanOptions
 from dispatches_tpu.solvers.ipm import IPMOptions, make_ipm_solver
 from dispatches_tpu.solvers.pdlp import (
@@ -125,6 +127,7 @@ from dispatches_tpu.solvers.pdlp import (
     START_EXACT,
     START_KIND_NAMES,
     START_NEIGHBOR,
+    START_PREDICTED,
     make_lp_data,
     make_pdlp_solver,
     resolve_pdlp_precision,
@@ -357,6 +360,30 @@ class _WarmStartCache:
             self._d.popitem(last=False)
 
 
+def _predict_head_fn(n: int):
+    """Per-lane predictor head for the warm-start ladder's rung 0.
+
+    ``(weights, vec, (x0, z0, kind)) -> (x0', z0', kind)``: lanes whose
+    kind is ``START_PREDICTED`` get their zero placeholder start
+    replaced by the MLP's prediction; every other lane passes through
+    untouched.  Weights are an *argument* (vmap axis None), so online
+    refits never retrace, and the output feeds the solver program's
+    donated start stack directly — inference stays on device with no
+    extra host round-trip (``ExecutionPlan.run_inline``)."""
+
+    def head(weights, vec, start):
+        import jax.numpy as jnp
+
+        x0, z0, kind = start
+        y = learn_predictor.forward(weights, vec)
+        is_pred = kind == START_PREDICTED
+        return (jnp.where(is_pred, y[:n].astype(x0.dtype), x0),
+                jnp.where(is_pred, y[n:].astype(z0.dtype), z0),
+                kind)
+
+    return head
+
+
 class _Bucket:
     """One shape bucket: a resolved solver kind, its plan-compiled
     vmapped kernel (compile-counted via graft_jit inside
@@ -367,9 +394,16 @@ class _Bucket:
         self.nlp = nlp
         self.pending: "deque[SolveHandle]" = deque()
         # graceful-degradation ladder state (docs/robustness.md):
+        # rung 0 — consecutive predicted-start mispredicts demote the
+        # learned predictor back to k-NN retrieval;
         # rung 1 — consecutive warm mispredicts demote to cold starts;
         # rung 2 — refine-failed lanes redirect new submissions to an
         # f32 twin bucket (``rebuild`` holds the constructor args)
+        self.predict_consec_mispredicts = 0
+        self.predict_fallback = False
+        self.predict_trainer = None
+        self.predict_program = None
+        self.predict_weights = None  # jnp-ready params of the live fit
         self.warm_consec_mispredicts = 0
         self.warm_fallback = False
         self.refine_fails = 0
@@ -474,6 +508,22 @@ class _Bucket:
                                     np.int32(START_COLD))
             self.warm_index = warmstart.WarmStartIndex()
             self.warm_guard = warmstart.MispredictGuard()
+            # ladder rung 0, the learned predictor: kill-switch OFF
+            # means nothing is constructed — the ladder is bitwise the
+            # retrieval-only path (the spy-pinned zero-overhead
+            # contract).  The head is a separate compiled program so
+            # the solver program's signature (and its compile counts)
+            # are untouched; its compiles are NOT in bucket.compiles.
+            if learn_predictor.predict_enabled():
+                self.predict_trainer = learn_train.OnlineTrainer(n, m)
+                self.warm_pred_start = (self.warm_cold_start[0],
+                                        self.warm_cold_start[1],
+                                        np.int32(START_PREDICTED))
+                self.predict_program = plan.program(
+                    _predict_head_fn(n),
+                    label=f"serve.{label}.predict",
+                    vmap_axes=(None, 0, 0),
+                    donate_argnums=(2,) if plan.options.donate else ())
             self.program = plan.program(
                 base, label=f"serve.{label}", vmap_axes=(0, 0),
                 donate_argnums=(1,) if plan.options.donate else ())
@@ -528,6 +578,7 @@ class SolveService:
         self._warm_hits = 0
         self._warm_misses = 0
         self._warm_neighbor_hits = 0
+        self._warm_predicted = 0
         self._submitted = 0
         self._solved = 0
         self._timeouts = 0
@@ -555,7 +606,16 @@ class SolveService:
             "(queue-depth / burn-signal rung; label = bucket)")
         self._obs_degrade = obs_registry.counter(
             "serve.degrade", "graceful-degradation rungs engaged "
-            "(rung=warm_cold|precision; label = bucket)")
+            "(rung=predict_knn|warm_cold|precision; label = bucket)")
+        self._obs_predict_starts = obs_registry.counter(
+            "predict.starts", "warm-start lanes seeded by the learned "
+            "predictor (ladder rung 0; label = bucket)")
+        self._obs_predict_refits = obs_registry.counter(
+            "predict.refits", "online warm-start predictor refits from "
+            "the replay buffer, ticked from poll (label = bucket)")
+        self._obs_predict_mispredicts = obs_registry.counter(
+            "predict.mispredicts", "predicted starts that converged "
+            "slower than the cold-baseline EMA (label = bucket)")
         self._obs_batches = obs_registry.counter(
             "serve.batches", "solve-service batches dispatched")
         _deadline = obs_registry.counter(
@@ -798,12 +858,24 @@ class SolveService:
             # cold init) — one donated stack carries all three kinds
             handle.param_vec = warmstart.param_vector(params)
             dt = bucket.warm_dtype
+            trainer = bucket.predict_trainer
             sol = bucket.warm_index.exact(handle.warm_key)
             if sol is not None:
                 self._warm_hits += 1
                 handle.start = (np.asarray(sol[0], dt),
                                 np.asarray(sol[1], dt),
                                 np.int32(START_EXACT))
+            elif (trainer is not None and not bucket.predict_fallback
+                    and trainer.ready()):
+                # ladder rung 0: a trained predictor covers the points
+                # retrieval whiffs on.  The start is the zero
+                # placeholder tagged START_PREDICTED — the actual
+                # (x0, z0) is computed on device at dispatch time by
+                # the bucket's predict head (no host inference here)
+                self._warm_predicted += 1
+                self._obs_predict_starts.inc(
+                    bucket=bucket.stats.label)
+                handle.start = bucket.warm_pred_start
             else:
                 nb = bucket.warm_index.nearest(handle.param_vec)
                 if nb is not None:
@@ -918,6 +990,22 @@ class SolveService:
                 self._snapshots.maybe_snapshot(self, now)
             except Exception:
                 pass  # a full disk must not take serving down with it
+        # online predictor refit — the one expensive learn call, and it
+        # runs HERE on the service clock beside the snapshot tick; the
+        # per-poll cost everywhere else is the O(1) due() gate, and the
+        # cadence is bounded (at most one refit per refit_every
+        # completed results per bucket)
+        for bucket in self._buckets.values():
+            trainer = bucket.predict_trainer
+            if (trainer is None or bucket.predict_fallback
+                    or not trainer.due()):
+                continue
+            try:
+                trainer.refit()
+            except Exception:
+                continue  # bad data must never take serving down
+            bucket.predict_weights = dict(trainer.predictor.params)
+            self._obs_predict_refits.inc(bucket=bucket.stats.label)
         return n
 
     def flush_all(self) -> int:
@@ -1110,6 +1198,29 @@ class SolveService:
                 stack = plan.stage(
                     plan.stack([r.start for r in subset], lanes=lanes_s),
                     lanes=lanes_s, donate=1 in argnums)
+                if (bucket.predict_weights is not None
+                        and any(int(r.start[2]) == START_PREDICTED
+                                for r in subset)):
+                    # rung-0 inference, batched and on device: the
+                    # predict head fills the PREDICTED lanes' zero
+                    # placeholders and passes every other lane
+                    # through; its output IS the solver's donated
+                    # start stack, so prediction costs no extra host
+                    # round-trip (run_inline = async dispatch, fenced
+                    # by the solver batch that consumes it)
+                    dt = bucket.warm_dtype
+                    d = int(np.asarray(
+                        bucket.predict_weights["in_mean"]).size)
+                    vec_rows = [
+                        (np.zeros(d, dt) if r.param_vec is None
+                         else np.asarray(r.param_vec, dt))
+                        for r in subset]
+                    vec_stack = plan.stage(
+                        plan.stack(vec_rows, lanes=lanes_s),
+                        lanes=lanes_s)
+                    stack = plan.run_inline(
+                        bucket.predict_program,
+                        (bucket.predict_weights, vec_stack, stack))
                 return (batched, stack), lanes_s
             return (batched,), lanes_s
 
@@ -1180,6 +1291,28 @@ class SolveService:
                 "serve.request", r._t_submit_us, t_us - r._t_submit_us,
                 request_id=r.request_id, bucket=bucket.stats.label,
                 status=RequestStatus.ERROR)
+
+    def _degrade_predict(self, bucket: _Bucket) -> None:
+        """Degradation rung 0: demote the learned predictor back to
+        k-NN retrieval after repeated consecutive predicted-start
+        mispredicts.  Sticky, like the other rungs: the bucket stops
+        consulting (and refitting) the predictor until restart — a
+        model that keeps losing to the cold baseline has drifted off
+        the stream and retraining it on the stream that broke it is
+        not a recovery plan."""
+        if bucket.predict_fallback:
+            return
+        bucket.predict_fallback = True
+        label = bucket.stats.label
+        self._obs_degrade.inc(rung="predict_knn", bucket=label)
+        if obs_flight.enabled():
+            obs_flight.trigger(
+                "degrade", bucket=label, label=f"serve.{label}",
+                solver_options={"kind": bucket.kind,
+                                "precision": bucket.precision},
+                detail={"rung": "predict_knn",
+                        "consecutive_mispredicts":
+                            bucket.predict_consec_mispredicts})
 
     def _degrade_warm(self, bucket: _Bucket) -> None:
         """Degradation rung 1: demote a bucket to cold starts after
@@ -1369,8 +1502,14 @@ class SolveService:
                 elif bucket.warm_guard.observe_warm(it_i):
                     # mispredicted start: converged slower than the
                     # cold baseline estimate — attributable via the
-                    # flight bundle's start_kind
-                    bucket.warm_consec_mispredicts += 1
+                    # flight bundle's start_kind.  Predicted lanes
+                    # carry their own streak so the ladder degrades
+                    # one rung at a time: predictor → k-NN → cold.
+                    if kind_i == START_PREDICTED:
+                        bucket.predict_consec_mispredicts += 1
+                        self._obs_predict_mispredicts.inc(bucket=label)
+                    else:
+                        bucket.warm_consec_mispredicts += 1
                     if flight_on:
                         obs_flight.trigger(
                             "warm_mispredict",
@@ -1386,9 +1525,17 @@ class SolveService:
                                 "cold_iters_ema":
                                     bucket.warm_guard.cold_iters_ema,
                             })
-                    if (bucket.warm_consec_mispredicts
+                    if (kind_i == START_PREDICTED
+                            and bucket.predict_consec_mispredicts
+                            >= self.options.degrade_mispredicts):
+                        self._degrade_predict(bucket)
+                    elif (kind_i != START_PREDICTED
+                            and bucket.warm_consec_mispredicts
                             >= self.options.degrade_mispredicts):
                         self._degrade_warm(bucket)
+                elif kind_i == START_PREDICTED:
+                    # a predicted start that paid off resets its streak
+                    bucket.predict_consec_mispredicts = 0
                 else:
                     # a warm start that paid off resets the streak
                     bucket.warm_consec_mispredicts = 0
@@ -1401,6 +1548,14 @@ class SolveService:
                     bucket.warm_index.add(r.warm_key, r.param_vec,
                                           np.asarray(lane.x),
                                           np.asarray(lane.z))
+                    # the same converged+finite gate feeds the online
+                    # trainer's replay buffer — a cheap bounded append;
+                    # the refit itself runs from poll, never here
+                    if (bucket.predict_trainer is not None
+                            and not bucket.predict_fallback):
+                        bucket.predict_trainer.observe(
+                            r.param_vec, np.asarray(lane.x),
+                            np.asarray(lane.z))
         if self._journal is not None and done_ids:
             self._journal.status(done_ids, RequestStatus.DONE)
         self._obs_solved.inc(n_done)
@@ -1495,20 +1650,23 @@ class SolveService:
         }
 
     def _warm_start_metrics(self) -> Dict:
-        """hits = exact (ipm LRU + pdlp fingerprint), neighbor_hits =
-        pdlp k-NN retrievals, misses = cold starts; hit_rate over all
-        lookups; size counts LRU entries + every bucket index entry."""
+        """hits = exact (ipm LRU + pdlp fingerprint), predicted =
+        learned-predictor starts, neighbor_hits = pdlp k-NN
+        retrievals, misses = cold starts; hit_rate over all lookups
+        (a predicted start is a hit: the request did not start cold);
+        size counts LRU entries + every bucket index entry."""
         warm_buckets = [b for b in self._buckets.values() if b.warm]
-        lookups = (self._warm_hits + self._warm_neighbor_hits
-                   + self._warm_misses)
+        served = (self._warm_hits + self._warm_predicted
+                  + self._warm_neighbor_hits)
+        lookups = served + self._warm_misses
         return {
             "hits": self._warm_hits,
+            "predicted": self._warm_predicted,
             "neighbor_hits": self._warm_neighbor_hits,
             "misses": self._warm_misses,
             "mispredicts": sum(b.warm_guard.mispredicts
                                for b in warm_buckets),
-            "hit_rate": ((self._warm_hits + self._warm_neighbor_hits)
-                         / lookups if lookups else 0.0),
+            "hit_rate": (served / lookups if lookups else 0.0),
             "size": len(self._warm) + sum(len(b.warm_index)
                                           for b in warm_buckets),
         }

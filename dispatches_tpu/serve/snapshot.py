@@ -13,9 +13,14 @@ otherwise relearn slowly:
   markers serialize exactly (five heights + positions + count), the
   ArrivalEstimate its EWMA gap — so a restarted service forms batches
   with yesterday's calibration, not the priors,
-* the degradation-ladder rungs (``warm_fallback``, consecutive
-  mispredicts, refine-fail count) so a service that degraded for a
-  reason does not un-degrade by dying.
+* each bucket's fitted warm-start predictor — the
+  :class:`~dispatches_tpu.learn.train.OnlineTrainer` weights and
+  training counters (the replay buffer is transient by design; a
+  restored service re-accumulates fresh results toward its next
+  refit),
+* the degradation-ladder rungs (``predict_fallback``,
+  ``warm_fallback``, consecutive mispredicts, refine-fail count) so a
+  service that degraded for a reason does not un-degrade by dying.
 
 Snapshots are schema-versioned JSON written atomically (tmp +
 ``os.replace``, the ledger pattern): a reader sees the previous
@@ -52,7 +57,12 @@ __all__ = [
     "save_snapshot",
 ]
 
-SCHEMA_VERSION = 1
+# v1: ladder/est/arrivals/warm_guard/warm_index.  v2 adds the bucket
+# "predictor" section (learn.OnlineTrainer weights + counters).  v1
+# snapshots stay loadable — they simply restore with no predictor
+# state, exactly the pre-predictor service.
+SCHEMA_VERSION = 2
+COMPAT_SCHEMAS = (1, 2)
 SNAPSHOT_FILE = "snapshot.json"
 DEFAULT_INTERVAL_S = 30.0
 
@@ -108,6 +118,13 @@ def _bucket_state(bucket) -> Dict:
     index = getattr(bucket, "warm_index", None)
     if index is not None and len(index):
         state["warm_index"] = journal_mod.encode_tree(index.to_state())
+    state["ladder"]["predict_fallback"] = bool(
+        getattr(bucket, "predict_fallback", False))
+    state["ladder"]["predict_consec_mispredicts"] = int(
+        getattr(bucket, "predict_consec_mispredicts", 0))
+    trainer = getattr(bucket, "predict_trainer", None)
+    if trainer is not None:
+        state["predictor"] = journal_mod.encode_tree(trainer.to_state())
     return state
 
 
@@ -140,6 +157,23 @@ def apply_bucket_state(bucket, state: Dict) -> None:
             getattr(bucket, "warm_index", None) is not None:
         bucket.warm_index = warmstart.WarmStartIndex.from_state(
             journal_mod.decode_tree(index_state))
+    if hasattr(bucket, "predict_fallback"):
+        bucket.predict_fallback = bool(
+            ladder.get("predict_fallback", False))
+        bucket.predict_consec_mispredicts = int(
+            ladder.get("predict_consec_mispredicts", 0))
+    # pre-v2 snapshots have no "predictor" section: the trainer keeps
+    # its fresh (untrained) state — predictor None, exactly the
+    # pre-PR-18 restore semantics
+    pred_state = state.get("predictor")
+    trainer = getattr(bucket, "predict_trainer", None)
+    if pred_state is not None and trainer is not None:
+        try:
+            trainer.load_state(journal_mod.decode_tree(pred_state))
+        except Exception:
+            pass  # a stale predictor must never block serving
+        if trainer.predictor is not None:
+            bucket.predict_weights = dict(trainer.predictor.params)
 
 
 # ---------------------------------------------------------------------------
@@ -195,7 +229,7 @@ def load_state(directory: str) -> Optional[Dict]:
             state = json.load(fh)
     except (OSError, ValueError):
         return None
-    if state.get("schema") != SCHEMA_VERSION:
+    if state.get("schema") not in COMPAT_SCHEMAS:
         return None
     return state
 

@@ -225,6 +225,18 @@ class WarmStartIndex:
         idx._cursor = count % idx.capacity
         return idx
 
+    def export_pairs(self) -> Tuple[list, list, list]:
+        """Training triples ``(vecs, xs, zs)`` in deterministic logical
+        order (oldest insertion first, post-eviction) — the predictor
+        trainer's second data source beside the sweep store's
+        ``training_pairs``.  Lists of per-entry arrays: solutions in an
+        index may be ragged across buckets; the caller stacks."""
+        order = self._logical_order()
+        vecs = [np.array(self._vecs[s], np.float64) for s in order]
+        xs = [np.asarray(self._sols[s][0]) for s in order]
+        zs = [np.asarray(self._sols[s][1]) for s in order]
+        return vecs, xs, zs
+
     def exact(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Exact-fingerprint lookup: the newest solution recorded under
         ``key``, or None."""

@@ -66,7 +66,8 @@ _HALPERN_STEP_SCALE = 0.98
 START_COLD = 0       # no reuse: the historical x=0/z=0 init
 START_EXACT = 1      # exact-key cache hit (same request fingerprint)
 START_NEIGHBOR = 2   # parameter-space k-NN retrieval (serve/warmstart)
-START_KIND_NAMES = ("cold", "exact", "neighbor")
+START_PREDICTED = 3  # learned-regression start (learn/predictor)
+START_KIND_NAMES = ("cold", "exact", "neighbor", "predicted")
 
 
 class LPResult(NamedTuple):
@@ -89,10 +90,11 @@ class LPResult(NamedTuple):
     #                              refinement budget)
     start_kind: jnp.ndarray = None  # provenance of the start this lane
     #                                 was seeded from (START_COLD /
-    #                                 START_EXACT / START_NEIGHBOR);
-    #                                 None when the caller passed no
-    #                                 start — the pre-warm-start result
-    #                                 layout, preserved bit-for-bit
+    #                                 START_EXACT / START_NEIGHBOR /
+    #                                 START_PREDICTED); None when the
+    #                                 caller passed no start — the
+    #                                 pre-warm-start result layout,
+    #                                 preserved bit-for-bit
 
 
 @dataclass(frozen=True)

@@ -276,6 +276,21 @@ class ResultStore:
         mask = (a["status"] < STATUS_QUARANTINED) & np.isfinite(a["obj"])
         return a["inputs"][mask], a["obj"][mask]
 
+    def training_pairs(self
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(inputs, x, z) triples for warm-start predictor training
+        (``learn.train.fit_from_store``): design coordinates vs the
+        saved scaled-space primal and original-space dual solutions,
+        quarantined/non-finite points dropped.  Only warm-start stores
+        persist x/z chunk arrays, so anything else raises."""
+        if not self.warm_start:
+            raise RuntimeError(
+                "training_pairs needs a warm_start=True store: only "
+                "warm-seeded sweeps persist the x/z solution arrays")
+        a = self.arrays()
+        mask = (a["status"] < STATUS_QUARANTINED) & np.isfinite(a["obj"])
+        return a["inputs"][mask], a["x"][mask], a["z"][mask]
+
     # -- telemetry ---------------------------------------------------------
 
     def progress(self) -> Dict:

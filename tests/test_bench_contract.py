@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r13_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r14_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -56,6 +56,13 @@ def test_preview_record_passes_schema(bench):
         assert key in out["warmstart"]
     for key in bench.WARMSTART_NONNULL_KEYS:
         assert out["warmstart"][key] is not None
+    # the learned-predictor A/B (r14, ISSUE 18): headline measured
+    for key in bench.PREDICT_KEYS:
+        assert key in out["predict"]
+    for key in bench.PREDICT_NONNULL_KEYS:
+        assert out["predict"][key] is not None
+    for key in bench.PREDICT_COLD_CACHE_KEYS:
+        assert key in out["predict"]["cold_cache"]
     # the chaos A/B (r12): recovery headline measured, never null
     for key in bench.CHAOS_KEYS:
         assert key in out["chaos"]
@@ -113,6 +120,43 @@ def test_preview_warmstart_ab(bench):
     # both arms inside the repo-wide objective parity budget
     assert ws["obj_rel_err_cold"] <= 1e-4
     assert ws["obj_rel_err_warm"] <= 1e-4
+
+
+def test_preview_predict_ab(bench):
+    """The r14 learned-predictor A/B backs the ISSUE-18 acceptance: on
+    the drifting replay the online-refit MLP start beats the retrieval
+    warm arm's iteration ratio (measured ~0.43x on the CPU preview) at
+    an objective error no worse than it, and on the cold-cache arm —
+    where the k-NN index records ZERO hits, so retrieval has nothing to
+    offer — the frozen predictor still cuts cold PDHG iterations by at
+    least 1.5x."""
+    out = json.load(open(PREVIEW))
+    pr = out["predict"]
+    ws = out["warmstart"]
+    # drift arm: prediction is at least as good as retrieval, and the
+    # recorded headline is self-consistent with the per-arm means
+    assert pr["pdhg_iters_pred_ratio"] <= ws["pdhg_iters_warm_ratio"]
+    assert pr["pdhg_iters_pred_ratio"] <= 0.5
+    assert pr["pdhg_iters_pred_ratio"] == pytest.approx(
+        pr["pdhg_iters_pred_mean"] / pr["pdhg_iters_cold_mean"], abs=1e-3)
+    # never buy iterations with accuracy: no worse than the warm arm,
+    # and both arms inside the repo-wide objective parity budget
+    assert pr["obj_rel_err_pred"] <= ws["obj_rel_err_warm"]
+    assert pr["obj_rel_err_cold"] <= 1e-4
+    assert pr["obj_rel_err_pred"] <= 1e-4
+    # the online-refit machinery actually ran: enough training stream
+    # for the offline base fit plus several on-cadence refits
+    assert pr["train_points"] >= pr["lanes"] * pr["steps"]
+    assert pr["refit_every"] >= 1 and pr["window"] >= 1
+    # cold-cache arm: retrieval whiffs (0 k-NN hits), inference carries
+    cc = pr["cold_cache"]
+    assert cc["knn_hits"] == 0
+    assert cc["points"] > 0
+    assert cc["iters_cut"] >= 1.5
+    assert cc["iters_cut"] == pytest.approx(
+        cc["pdhg_iters_cold_mean"] / cc["pdhg_iters_pred_mean"], abs=1e-3)
+    assert cc["obj_rel_err_cold"] <= 1e-4
+    assert cc["obj_rel_err_pred"] <= 1e-4
 
 
 def test_preview_pdlp_variant_ab(bench):
@@ -345,6 +389,23 @@ def test_validate_rejects_missing_keys(bench):
         bench.validate_bench_output(out)
     out = json.load(open(PREVIEW))
     del out["warmstart"]
+    bench.validate_bench_output(out)
+    # predict (r14): optional-but-complete, headline non-null, and the
+    # cold-cache sub-record must carry its full key set
+    out = json.load(open(PREVIEW))
+    del out["predict"]["pdhg_iters_pred_ratio"]
+    with pytest.raises(ValueError, match="pdhg_iters_pred_ratio"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["predict"]["pdhg_iters_pred_ratio"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["predict"]["cold_cache"]["knn_hits"]
+    with pytest.raises(ValueError, match="knn_hits"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["predict"]
     bench.validate_bench_output(out)
     # chaos (r12): optional-but-complete, recovery headline non-null
     out = json.load(open(PREVIEW))

@@ -197,6 +197,78 @@ def test_warm_index_state_round_trip_nearest_bitwise():
         assert float(a[2]) == float(b[2])  # bitwise, not approx
 
 
+class _LadderBucket:
+    """The slice of a serve bucket the snapshot codec reads/writes —
+    enough to pin the v2 predictor round trip without a live service."""
+
+    def __init__(self, n=3, m=2):
+        from dispatches_tpu.learn import OnlineTrainer
+
+        self.warm_fallback = False
+        self.warm_consec_mispredicts = 0
+        self.refine_fails = 0
+        self.est = None
+        self.arrivals = None
+        self.warm_guard = warmstart.MispredictGuard()
+        self.warm_index = warmstart.WarmStartIndex(capacity=8)
+        self.predict_fallback = False
+        self.predict_consec_mispredicts = 0
+        self.predict_trainer = OnlineTrainer(n, m, hidden=4)
+        self.predict_weights = None
+
+
+def test_snapshot_v2_round_trips_predictor_weights_bitwise():
+    """The v2 snapshot schema (ISSUE 18) persists each bucket's fitted
+    warm-start predictor: weights and training counters survive the
+    JSON codec bitwise, the live ``predict_weights`` are re-staged for
+    the dispatch head, and the new ladder rung restores sticky."""
+    from dispatches_tpu.learn import fit
+
+    rng = np.random.default_rng(7)
+    b = _LadderBucket()
+    vecs = rng.standard_normal((16, 4)).astype(np.float32)
+    xs = rng.standard_normal((16, 3)).astype(np.float32)
+    zs = rng.standard_normal((16, 2)).astype(np.float32)
+    b.predict_trainer.adopt(fit(vecs, xs, zs, hidden=4, epochs=20),
+                            trained_samples=16)
+    b.predict_fallback = True  # degraded rungs must not un-degrade
+    b.predict_consec_mispredicts = 3
+    state = json.loads(json.dumps(snapshot._bucket_state(b)))
+    b2 = _LadderBucket()
+    snapshot.apply_bucket_state(b2, state)
+    assert b2.predict_trainer.ready()
+    assert b2.predict_trainer.trained_samples == 16
+    for k, v in b.predict_trainer.predictor.params.items():
+        assert np.asarray(v).tobytes() == \
+            np.asarray(b2.predict_trainer.predictor.params[k]).tobytes(), k
+    assert b2.predict_weights is not None
+    assert b2.predict_fallback
+    assert b2.predict_consec_mispredicts == 3
+
+
+def test_snapshot_v1_schema_loads_with_predictor_fresh(tmp_path):
+    """Backward compat: a pre-PR-18 (schema 1) snapshot — no
+    ``predictor`` section, no predict-ladder keys — still loads and
+    restores cleanly; the trainer simply starts untrained, exactly the
+    pre-predictor service.  Unknown future schemas stay refused."""
+    state = {"schema": 1, "generation": 3, "t": 0.0, "warm_lru": [],
+             "buckets": {"pdlp#0": {"ladder": {
+                 "warm_fallback": True,
+                 "warm_consec_mispredicts": 2,
+                 "refine_fails": 0}}}}
+    (tmp_path / snapshot.SNAPSHOT_FILE).write_text(json.dumps(state))
+    loaded = snapshot.load_state(str(tmp_path))
+    assert loaded is not None and loaded["generation"] == 3
+    b = _LadderBucket()
+    snapshot.apply_bucket_state(b, loaded["buckets"]["pdlp#0"])
+    assert b.warm_fallback and b.warm_consec_mispredicts == 2
+    assert not b.predict_fallback
+    assert not b.predict_trainer.ready() and b.predict_weights is None
+    state["schema"] = 99
+    (tmp_path / snapshot.SNAPSHOT_FILE).write_text(json.dumps(state))
+    assert snapshot.load_state(str(tmp_path)) is None
+
+
 # ---------------------------------------------------------------------------
 # service crash recovery
 # ---------------------------------------------------------------------------

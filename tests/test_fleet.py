@@ -400,6 +400,65 @@ def test_gossip_est_adoption_is_cold_only():
     assert bucket.est.samples > own  # its own evidence keeps accruing
 
 
+def test_gossip_predictor_adoption_is_most_trained_wins():
+    """ISSUE-18 fleet contract: gossip carries each bucket's fitted
+    warm-start predictor, adopted most-trained-wins — a donor with
+    strictly more training samples replaces the recipient's fit
+    wholesale (bitwise, never averaged), and the better-trained model
+    flows back on the next round once the roles invert."""
+    from dispatches_tpu.learn import fit
+
+    clk = FakeClock()
+    warm_solver = make_stub_solver(warm=True)
+    nlp = StubNLP()
+
+    def make_service(replica_id, journal_dir):
+        plan = ExecutionPlan(PlanOptions(inflight=2))
+        return SolveService(ServeOptions(max_batch=4, max_wait_ms=5.0,
+                                         warm_start=True, plan=plan),
+                            clock=clk, journal_dir=journal_dir)
+
+    router = FleetRouter(
+        FleetOptions(n_replicas=2, gossip_interval_s=1.0, affinity=False),
+        clock=clk, make_service=make_service)
+    warm_opts = {"warm_contract": True, "warm_dims": (nlp.n, 1)}
+    donor = router.replicas[0].service
+    recipient = router.replicas[1].service
+    for svc, i in ((donor, 0), (recipient, 1)):
+        svc.submit(nlp, _params(nlp, i), solver="pdlp",
+                   base_solver=warm_solver, options=dict(warm_opts))
+        svc.flush_all()
+    db = next(iter(donor._buckets.values()))
+    rb = next(iter(recipient._buckets.values()))
+    assert db.predict_trainer is not None and rb.predict_trainer is not None
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((16, 4)).astype(np.float32)
+    xs = rng.standard_normal((16, nlp.n)).astype(np.float32)
+    zs = rng.standard_normal((16, 1)).astype(np.float32)
+    db.predict_trainer.adopt(fit(vecs, xs, zs, hidden=4, epochs=20),
+                             trained_samples=16)
+    assert not rb.predict_trainer.ready()
+    router._gossip.exchange()
+    assert rb.predict_trainer.ready()
+    assert rb.predict_trainer.trained_samples == 16
+    for k, v in db.predict_trainer.predictor.params.items():
+        assert np.asarray(v).tobytes() == np.asarray(
+            rb.predict_trainer.predictor.params[k]).tobytes(), k
+    assert rb.predict_weights is not None  # staged for the dispatch head
+    # roles invert: the recipient refits on more evidence and the next
+    # round carries its model back; equal counts never churn weights
+    better = fit(vecs, xs + 1.0, zs, hidden=4, epochs=20)
+    rb.predict_trainer.adopt(better, trained_samples=32)
+    router._gossip.exchange()
+    assert db.predict_trainer.trained_samples == 32
+    for k, v in better.params.items():
+        assert np.asarray(v).tobytes() == np.asarray(
+            db.predict_trainer.predictor.params[k]).tobytes(), k
+    router._gossip.exchange()  # 32 == 32: nobody adopts
+    assert db.predict_trainer.trained_samples == 32
+    assert rb.predict_trainer.trained_samples == 32
+
+
 # ---------------------------------------------------------------------------
 # env plumbing + soak integration
 # ---------------------------------------------------------------------------

@@ -1,0 +1,243 @@
+"""Learned warm-start predictor (``dispatches_tpu.learn``): the MLP
+head's fit/predict contract, state codecs, the bounded replay buffer,
+and the OnlineTrainer refit cadence — the pieces serve's ladder rung 0
+is built from (the serve-side integration is covered in test_serve.py,
+snapshots in test_durability.py, gossip in test_fleet.py).
+"""
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.learn import (
+    OnlineTrainer,
+    ReplayBuffer,
+    StartPredictor,
+    default_hidden,
+    default_refit_every,
+    fit,
+    fit_from_index,
+    forward,
+    init_params,
+    predict_enabled,
+    snap_to_bounds,
+)
+from dispatches_tpu.serve.warmstart import WarmStartIndex
+
+D, N, M = 4, 6, 5
+
+
+def _linear_problem(rows, seed=0):
+    """Synthetic training set whose solution map IS linear — the model's
+    residual linear path must drive the fit error to ~0 on it."""
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((D, N + M)).astype(np.float32)
+    b = rng.standard_normal(N + M).astype(np.float32)
+    vecs = rng.standard_normal((rows, D)).astype(np.float32)
+    Y = vecs @ A + b
+    return vecs, Y[:, :N], Y[:, N:], (A, b)
+
+
+# ---------------------------------------------------------------------------
+# fit / predict
+# ---------------------------------------------------------------------------
+
+
+def test_fit_recovers_linear_map_and_is_deterministic():
+    vecs, xs, zs, (A, b) = _linear_problem(64)
+    pred = fit(vecs, xs, zs, hidden=8, epochs=1200)
+    probe = np.asarray([0.3, -0.2, 0.5, 0.1], np.float32)
+    want = probe @ A + b
+    x0, z0 = pred.predict(probe)
+    assert x0.shape == (N,) and z0.shape == (M,)
+    np.testing.assert_allclose(np.concatenate([x0, z0]), want,
+                               rtol=0.0, atol=0.2)
+    # deterministic for fixed inputs/seed: refitting gives bitwise-equal
+    # weights (the serve refit path depends on this for reproducibility)
+    pred2 = fit(vecs, xs, zs, hidden=8, epochs=1200)
+    for k, v in pred.params.items():
+        assert np.asarray(v).tobytes() == \
+            np.asarray(pred2.params[k]).tobytes(), k
+
+
+def test_fit_drops_nonfinite_rows_and_rejects_empty():
+    vecs, xs, zs, _ = _linear_problem(16)
+    xs = xs.copy()
+    xs[3, 0] = np.nan  # a diverged solve must never steer the fit
+    pred = fit(vecs, xs, zs, hidden=4, epochs=50)
+    x0, _ = pred.predict(vecs[0])
+    assert np.all(np.isfinite(x0))
+    with pytest.raises(ValueError, match="finite"):
+        fit(vecs[:1], np.full((1, N), np.nan), zs[:1])
+
+
+def test_forward_matches_host_predict():
+    """The device head (what serve stages through the ExecutionPlan)
+    and the host predict() must be the same function."""
+    vecs, xs, zs, _ = _linear_problem(32, seed=3)
+    pred = fit(vecs, xs, zs, hidden=8, epochs=100)
+    y_dev = np.asarray(forward(pred.params, vecs[5]))
+    x0, z0 = pred.predict(vecs[5])
+    np.testing.assert_allclose(y_dev, np.concatenate([x0, z0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_untrained_model_predicts_the_mean_solution():
+    params = init_params(D, N, M, hidden=4)
+    params["out_mean"] = np.linspace(1.0, 2.0, N + M).astype(np.float32)
+    pred = StartPredictor(params, N, M)
+    x0, z0 = pred.predict(np.ones(D, np.float32))
+    np.testing.assert_allclose(np.concatenate([x0, z0]),
+                               params["out_mean"], atol=1e-6)
+
+
+def test_predictor_state_round_trip_bitwise():
+    vecs, xs, zs, _ = _linear_problem(16, seed=5)
+    pred = fit(vecs, xs, zs, hidden=4, epochs=50)
+    back = StartPredictor.from_state(pred.to_state())
+    assert (back.n, back.m, back.d, back.hidden) == \
+        (pred.n, pred.m, pred.d, pred.hidden)
+    for k, v in pred.params.items():
+        assert np.asarray(v).tobytes() == \
+            np.asarray(back.params[k]).tobytes(), k
+    assert StartPredictor.from_state(None) is None
+
+
+def test_snap_to_bounds_restores_active_set_primal_only():
+    lb = np.asarray([0.0, -1.0, -np.inf, 0.0], np.float32)
+    ub = np.asarray([2.0, 1.0, np.inf, 0.0], np.float32)
+    x = np.asarray([1e-4,     # eps-close to lb -> snapped to 0
+                    1.00005,  # eps-close to ub -> snapped to 1
+                    123.4,    # free coordinate untouched
+                    0.5],     # outside a degenerate box -> clipped
+                   np.float32)
+    out = snap_to_bounds(x, lb, ub)
+    np.testing.assert_array_equal(
+        out, np.asarray([0.0, 1.0, 123.4, 0.0], np.float32))
+    # interior points survive: nothing within eps of a bound moves
+    mid = np.asarray([1.0, 0.3, -5.0, 0.0], np.float32)
+    np.testing.assert_array_equal(snap_to_bounds(mid, lb, ub), mid)
+
+
+def test_fit_from_index_uses_export_pairs():
+    idx = WarmStartIndex()
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((3, N + M)).astype(np.float32)
+    for i in range(12):
+        v = rng.standard_normal(3)
+        y = (v @ A).astype(np.float32)
+        idx.add(("k", i), v, y[:N], y[N:])
+    pred = fit_from_index(idx, hidden=4, epochs=300)
+    v = np.asarray([0.2, -0.4, 0.1])
+    x0, z0 = pred.predict(v.astype(np.float32))
+    np.testing.assert_allclose(np.concatenate([x0, z0]),
+                               (v @ A).astype(np.float32), atol=0.3)
+    with pytest.raises(ValueError, match="empty"):
+        fit_from_index(WarmStartIndex())
+
+
+# ---------------------------------------------------------------------------
+# replay buffer + online trainer
+# ---------------------------------------------------------------------------
+
+
+def test_replay_buffer_bounded_ordered_and_finite_gated():
+    buf = ReplayBuffer(capacity=4)
+    for i in range(6):
+        buf.append(np.full(D, i), np.full(N, i), np.full(M, i))
+    buf.append(np.full(D, np.nan), np.zeros(N), np.zeros(M))  # dropped
+    assert len(buf) == 4
+    vecs, xs, zs = buf.arrays()
+    # oldest two evicted; survivors come back oldest-first
+    np.testing.assert_array_equal(vecs[:, 0], [2, 3, 4, 5])
+    np.testing.assert_array_equal(xs[:, 0], [2, 3, 4, 5])
+    np.testing.assert_array_equal(zs[:, 0], [2, 3, 4, 5])
+    with pytest.raises(ValueError):
+        ReplayBuffer(capacity=0)
+
+
+def test_online_trainer_cadence_and_refit():
+    tr = OnlineTrainer(N, M, hidden=4, refit_every=8, min_points=8)
+    vecs, xs, zs, _ = _linear_problem(16, seed=7)
+    assert not tr.ready() and not tr.due()
+    for i in range(7):
+        tr.observe(vecs[i], xs[i], zs[i])
+    assert not tr.due()  # 7 < refit_every
+    tr.observe(vecs[7], xs[7], zs[7])
+    assert tr.due()
+    tr.refit(epochs=50)
+    assert tr.ready() and tr.refits == 1 and tr.trained_samples == 8
+    assert not tr.due()  # pending reset; cadence restarts
+    for i in range(8, 16):
+        tr.observe(vecs[i], xs[i], zs[i])
+    assert tr.due()
+
+
+def test_online_trainer_window_refit_uses_recent_rows():
+    """A windowed refit must fit the RECENT regime, not the stale one:
+    feed two conflicting linear maps and check the window tracks the
+    second (the drifting-stream policy bench.py's predict arm uses)."""
+    rng = np.random.default_rng(11)
+    vecs = rng.standard_normal((48, D)).astype(np.float32)
+    A_old = np.ones((D, N + M), np.float32)
+    A_new = -np.ones((D, N + M), np.float32)
+    tr = OnlineTrainer(N, M, hidden=4, refit_every=1)
+    for i in range(48):
+        A = A_old if i < 32 else A_new
+        y = vecs[i] @ A
+        tr.observe(vecs[i], y[:N], y[N:])
+    tr.refit(window=16, epochs=400)
+    probe = vecs[40]
+    x0, z0 = tr.predictor.predict(probe)
+    np.testing.assert_allclose(np.concatenate([x0, z0]), probe @ A_new,
+                               atol=0.2)
+    # never below min_points, even for a tiny window
+    tr.refit(window=1, epochs=10)
+    assert tr.refits == 2
+
+
+def test_online_trainer_adopt_checks_shape_and_counters():
+    tr = OnlineTrainer(N, M, hidden=4)
+    vecs, xs, zs, _ = _linear_problem(16, seed=9)
+    pred = fit(vecs, xs, zs, hidden=4, epochs=20)
+    tr.adopt(pred, trained_samples=16)
+    assert tr.ready() and tr.trained_samples == 16
+    bad = fit(vecs, xs[:, :-1], zs, hidden=4, epochs=20)
+    with pytest.raises(ValueError, match="shape"):
+        tr.adopt(bad, trained_samples=99)
+
+
+def test_online_trainer_state_round_trip_keeps_weights():
+    tr = OnlineTrainer(N, M, hidden=4, refit_every=4)
+    vecs, xs, zs, _ = _linear_problem(8, seed=13)
+    for i in range(8):
+        tr.observe(vecs[i], xs[i], zs[i])
+    tr.refit(epochs=30)
+    state = tr.to_state()
+    tr2 = OnlineTrainer(N, M, hidden=4, refit_every=4)
+    tr2.load_state(state)
+    assert tr2.ready()
+    assert (tr2.samples, tr2.trained_samples, tr2.refits) == (8, 8, 1)
+    for k, v in tr.predictor.params.items():
+        assert np.asarray(v).tobytes() == \
+            np.asarray(tr2.predictor.params[k]).tobytes(), k
+    # the replay buffer is transient by design: a restored trainer
+    # re-accumulates fresh results toward its next refit
+    assert len(tr2.buffer) == 0
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+
+
+def test_flags_drive_defaults(monkeypatch):
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART_PREDICT", raising=False)
+    assert predict_enabled()  # ON by default
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_PREDICT", "0")
+    assert not predict_enabled()
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_PREDICT", "1")
+    assert predict_enabled()
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_PREDICT_HIDDEN", "64")
+    assert default_hidden() == 64
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_PREDICT_REFIT_N", "17")
+    assert default_refit_every() == 17

@@ -502,6 +502,101 @@ def test_pdlp_warm_start_off_is_zero_overhead(nlp8, monkeypatch):
         assert ws["hits"] == 0 and ws["neighbor_hits"] == 0
 
 
+def test_pdlp_predictor_ladder_degrades_one_rung_at_a_time(nlp8, monkeypatch):
+    """ISSUE-18 ladder contract: with a trained predictor live, fresh
+    points seed from rung 0 (START_PREDICTED); repeated predicted-start
+    mispredicts demote rung 0 back to k-NN retrieval (START_NEIGHBOR),
+    and repeated retrieval mispredicts demote to cold — one rung at a
+    time, both demotions sticky."""
+    from dispatches_tpu.learn import fit as learn_fit
+
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART_PREDICT", raising=False)
+    svc = SolveService(
+        ServeOptions(max_batch=1, max_wait_ms=1e9, degrade_mispredicts=2),
+        clock=FakeClock())
+    rng = np.random.default_rng(11)
+    p0 = _price_params(nlp8, 8, rng)
+    opts = {"tol": 1e-7, "dtype": "float64"}
+    r0 = svc.solve(nlp8, p0, solver="pdlp", options=opts)
+    assert int(r0.start_kind) == 0  # first contact is cold
+    bucket = next(iter(svc._buckets.values()))
+    trainer = bucket.predict_trainer
+    assert trainer is not None and not trainer.ready()
+    # promote the trainer to ready the production way: fit from the
+    # bucket's own index export and adopt (what gossip/snapshot do)
+    vecs, xs, zs = bucket.warm_index.export_pairs()
+    pred = learn_fit(np.stack(vecs).astype(np.float32), np.stack(xs),
+                     np.stack(zs), hidden=4, epochs=10)
+    trainer.adopt(pred, trained_samples=len(vecs))
+    bucket.predict_weights = dict(pred.params)
+    # pin the guard's cold baseline low so every warm-family start
+    # counts as a mispredict — the ladder must walk down deterministically
+    bucket.warm_guard.cold_iters_ema = 0.5
+    kinds = []
+    for i in range(5):
+        p = {"p": {**p0["p"], "price": p0["p"]["price"] * (1.0 + 1e-3 * (i + 1))},
+             "fixed": p0["fixed"]}
+        r = svc.solve(nlp8, p, solver="pdlp", options=opts)
+        assert np.isfinite(float(r.obj))
+        kinds.append(int(r.start_kind))
+    # predictor, predictor (2 mispredicts -> demote), neighbor, neighbor
+    # (2 more -> demote), cold
+    assert kinds == [3, 3, 2, 2, 0]
+    assert bucket.predict_fallback and bucket.warm_fallback
+    ws = svc.metrics()["warm_start"]
+    assert ws["predicted"] == 2
+    assert ws["neighbor_hits"] == 2
+
+
+def test_pdlp_predict_kill_switch_bitwise_and_zero_overhead(nlp8, monkeypatch):
+    """WARMSTART_PREDICT=0 must reproduce the PR-12 retrieval ladder
+    BITWISE, and spy-pinned zero-overhead: with the kill-switch set no
+    trainer is constructed and no predict head is ever staged — both
+    spies raise, so any touch fails the solve."""
+    from dispatches_tpu.serve import service as service_mod
+
+    def _run():
+        svc = SolveService(ServeOptions(max_batch=4, max_wait_ms=1e9),
+                           clock=FakeClock())
+        rng = np.random.default_rng(13)
+        plist = [_price_params(nlp8, 8, rng) for _ in range(4)]
+        opts = {"tol": 1e-7, "dtype": "float64"}
+        out = list(svc.solve_many(nlp8, plist, solver="pdlp", options=opts))
+        # identical resubmission -> exact hits; 0.1% perturbation ->
+        # neighbor hits: the full retrieval ladder below rung 0
+        out += svc.solve_many(nlp8, plist, solver="pdlp", options=opts)
+        plist3 = [{"p": {**p["p"], "price": p["p"]["price"] * 1.001},
+                   "fixed": p["fixed"]} for p in plist]
+        out += svc.solve_many(nlp8, plist3, solver="pdlp", options=opts)
+        return out, svc.metrics()["warm_start"]
+
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART", raising=False)
+    monkeypatch.delenv("DISPATCHES_TPU_WARMSTART_PREDICT", raising=False)
+    r_on, ws_on = _run()
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "predictor machinery touched with WARMSTART_PREDICT=0")
+
+    monkeypatch.setenv("DISPATCHES_TPU_WARMSTART_PREDICT", "0")
+    monkeypatch.setattr(service_mod.learn_train, "OnlineTrainer", _boom)
+    monkeypatch.setattr(service_mod, "_predict_head_fn", _boom)
+    r_off, ws_off = _run()
+    for a, b in zip(r_on, r_off):
+        assert np.asarray(a.result.x).tobytes() == \
+            np.asarray(b.result.x).tobytes()
+        assert np.asarray(a.result.z).tobytes() == \
+            np.asarray(b.result.z).tobytes()
+        assert int(a.result.iters) == int(b.result.iters)
+        assert int(a.result.start_kind) == int(b.result.start_kind)
+        assert float(a.obj) == float(b.obj)
+    # an untrained (never-ready) trainer must not change arithmetic, and
+    # neither arm ever seeded from the predictor
+    assert ws_on["predicted"] == 0 and ws_off["predicted"] == 0
+    assert ws_off["hits"] == 4 and ws_off["neighbor_hits"] == 4
+
+
 # ---------------------------------------------------------------------
 # entry points: factory, bidder, CLI
 # ---------------------------------------------------------------------

@@ -133,6 +133,45 @@ def test_capacity_must_be_positive():
 
 
 # ---------------------------------------------------------------------------
+# training-pair export (learn/ feeds from this)
+# ---------------------------------------------------------------------------
+
+
+def test_export_pairs_deterministic_insertion_order():
+    """export_pairs() is the offline-training feed (learn.fit_from_index):
+    same insertions ⇒ the same (vecs, xs, zs) rows in the same order,
+    oldest-first, so a refit on two replicas of the index is bitwise
+    reproducible."""
+    a, b = WarmStartIndex(capacity=64), WarmStartIndex(capacity=64)
+    _fill(a, 10)
+    _fill(b, 10)
+    va, xa, za = a.export_pairs()
+    vb, xb, zb = b.export_pairs()
+    assert len(va) == len(xa) == len(za) == 10
+    for i in range(10):
+        assert np.asarray(va[i]).tobytes() == np.asarray(vb[i]).tobytes()
+        assert np.asarray(xa[i]).tobytes() == np.asarray(xb[i]).tobytes()
+        assert np.asarray(za[i]).tobytes() == np.asarray(zb[i]).tobytes()
+        # oldest-first: row i is insertion i (x rows were filled with i)
+        assert float(xa[i][0]) == float(i)
+
+
+def test_export_pairs_order_survives_eviction():
+    """Past capacity the ring wraps; the export must still come back in
+    LOGICAL (oldest-surviving-first) order, not raw slot order — a
+    wrapped cursor must never interleave new rows before older ones."""
+    cap = 8
+    idx = WarmStartIndex(capacity=cap)
+    _fill(idx, 3 * cap - 3)  # cursor mid-ring: slots wrapped twice
+    vecs, xs, zs = idx.export_pairs()
+    assert len(vecs) == cap
+    got = [float(x[0]) for x in xs]
+    # survivors are exactly the newest `cap` insertions, oldest first
+    assert got == [float(i) for i in range(2 * cap - 3, 3 * cap - 3)]
+    assert [float(-z[0]) for z in zs] == got
+
+
+# ---------------------------------------------------------------------------
 # mispredict guard
 # ---------------------------------------------------------------------------
 
