@@ -392,6 +392,25 @@ FLEET_KEYS = ("n_requests", "n_replicas", "solves_per_sec_1",
 FLEET_NONNULL_KEYS = ("fleet_scaling_efficiency",
                       "replica_lost_request_rate")
 
+#: multi-process fleet A/B (ISSUE 19): the same stub stream through
+#: REAL worker processes (``python -m dispatches_tpu.net --worker``)
+#: behind RemoteReplicaHandles on loopback — 1 worker vs 3, plus the
+#: same 3-replica fleet in-process (A/B: what the wire + process
+#: isolation buy/cost), plus a kill arm that SIGKILLs one worker
+#: mid-stream and re-homes its journal across process boundaries.
+#: ``multihost_scaling_efficiency`` is solves/s-per-process of the
+#: 3-worker arm over the 1-worker arm (gated, higher is better) and
+#: ``remote_lost_request_rate`` is the kill arm's fraction of accepted
+#: requests that never reached a terminal status (gated, lower is
+#: better; the cross-process no-hang contract is exactly 0).
+MULTIPROC_FLEET_KEYS = (
+    "n_requests", "n_workers", "service_ms",
+    "solves_per_sec_1w", "solves_per_sec_3w", "solves_per_sec_inproc",
+    "multihost_scaling_efficiency", "remote_lost_request_rate",
+    "failovers", "rehomed", "hung", "requests_done_kill")
+MULTIPROC_FLEET_NONNULL_KEYS = ("multihost_scaling_efficiency",
+                                "remote_lost_request_rate")
+
 
 def validate_bench_output(out):
     """Raise ValueError when ``out`` breaks the single-line contract;
@@ -534,6 +553,18 @@ def validate_bench_output(out):
             raise ValueError(
                 f"bench fleet headline metrics must be measured, "
                 f"not null: {nulls}")
+    mp = out.get("multiproc_fleet")
+    if mp is not None:
+        missing = [k for k in MULTIPROC_FLEET_KEYS if k not in mp]
+        if missing:
+            raise ValueError(
+                f"bench multiproc_fleet missing sub-keys: {missing}")
+        nulls = [k for k in MULTIPROC_FLEET_NONNULL_KEYS
+                 if mp.get(k) is None]
+        if nulls:
+            raise ValueError(
+                f"bench multiproc_fleet headline metrics must be "
+                f"measured, not null: {nulls}")
     return out
 
 
@@ -624,6 +655,17 @@ def _finalize_output(out):
         if fleet.get("replica_lost_request_rate") is not None:
             metrics["replica_lost_request_rate"] = \
                 fleet["replica_lost_request_rate"]
+        # multiproc_fleet section: per-process scaling across REAL
+        # worker processes is gated (higher is better — the wire/RPC
+        # tax must not creep) and the kill arm's lost fraction is
+        # gated (lower is better; cross-process handoff loses exactly 0)
+        mp = out.get("multiproc_fleet") or {}
+        if mp.get("multihost_scaling_efficiency") is not None:
+            metrics["multihost_scaling_efficiency"] = \
+                mp["multihost_scaling_efficiency"]
+        if mp.get("remote_lost_request_rate") is not None:
+            metrics["remote_lost_request_rate"] = \
+                mp["remote_lost_request_rate"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -1805,6 +1847,249 @@ def run_bench():
             }
     except Exception as exc:
         out["fleet_bench_error"] = str(exc)[:120]
+
+    # ---- multi-process fleet A/B (ISSUE 19): real worker processes
+    # (python -m dispatches_tpu.net --worker) on loopback behind
+    # RemoteReplicaHandles — 1 worker vs 3 workers vs the same fleet
+    # policy in-process, plus a SIGKILL-one arm whose journal re-homes
+    # across process boundaries.  max_batch=1 makes the modeled
+    # per-batch wall-clock a per-REQUEST cost, so one worker is a
+    # strict ~1/service_ms serial server (the plan fence lock
+    # serializes completions) and the 3-worker arm measures genuine
+    # process-level scaling; the in-process twin isolates what the
+    # wire itself costs.  multihost_scaling_efficiency and
+    # remote_lost_request_rate feed the gated ledger -----------------
+    mp_procs = []
+    mp_root = None
+    try:
+        if time.monotonic() < deadline:
+            import shutil
+            import signal as _signal
+            import tempfile as _tempfile
+
+            from dispatches_tpu.fleet import (
+                FleetOptions,
+                FleetRouter,
+                connect_fleet,
+            )
+            from dispatches_tpu.net.worker import _modeled_plan
+            from dispatches_tpu.obs.soak import StubNLP, make_stub_solver
+            from dispatches_tpu.serve.service import (
+                ServeOptions,
+                SolveService,
+            )
+
+            # 90 ms modeled service: long enough that the single-core
+            # driver (6 submitter threads + the poll pump sharing one
+            # CPU with all the workers) is not the bottleneck in the
+            # 3-worker arm — the box here is CPU-starved in a way a
+            # real deployment is not, so the A/B must be service-bound
+            MP_N = 144
+            MP_SERVICE_MS = 90.0
+            MP_BATCH = 1
+            MP_THREADS = 6
+            mp_root = _tempfile.mkdtemp(prefix="dispatches-mpfleet-")
+
+            def _spawn_worker(tag, idx):
+                jdir = os.path.join(mp_root, f"{tag}-w{idx}")
+                return subprocess.Popen(
+                    [sys.executable, "-m", "dispatches_tpu.net",
+                     "--worker", "--port", "0", "--journal-dir", jdir,
+                     "--model", "stub", "--max-batch", str(MP_BATCH),
+                     "--max-wait-ms", "5", "--tick-ms", "5",
+                     "--service-ms", str(MP_SERVICE_MS)],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True)
+
+            # spawn every arm's workers up front so the interpreter/jax
+            # import cost is paid once, concurrently
+            groups = {"a": 1, "b": 3, "k": 3}
+            by_group = {}
+            for tag, n in groups.items():
+                by_group[tag] = [_spawn_worker(tag, i) for i in range(n)]
+                mp_procs.extend(by_group[tag])
+            endpoints = {}
+            for tag, procs_ in by_group.items():
+                eps = []
+                for p in procs_:
+                    ready = json.loads(p.stdout.readline())
+                    eps.append(("127.0.0.1", ready["port"]))
+                endpoints[tag] = eps
+
+            mp_nlp = StubNLP()
+            mp_solver = make_stub_solver()
+            mp_base = mp_nlp.default_params()
+
+            def _drive(router, kill_at=None, kill_proc=None, arm=""):
+                """Submit MP_N varied-param requests from MP_THREADS
+                concurrent submitter threads (with max_batch=1 each
+                submit RPC carries the worker's modeled service time,
+                so a single driver thread would itself be the serial
+                bottleneck), pump poll/flush from this thread,
+                optionally SIGKILL one worker once ``kill_at``
+                submissions are in; returns (elapsed_s, done, hung,
+                failovers, rehomed, lost)."""
+                import numpy as _np
+
+                handles = []
+                n_submitted = [0]
+                submit_failures = [0]
+                hlock = threading.Lock()
+                per = MP_N // MP_THREADS
+
+                def _submitter(k):
+                    for j in range(per):
+                        i = k * per + j
+                        params = {"p": {"price": _np.asarray(
+                            mp_base["p"]["price"]) * (1.0 + 0.001 * i)},
+                            "fixed": {}}
+                        h = None
+                        for _attempt in range(6):
+                            try:
+                                h = router.submit(
+                                    mp_nlp, params, solver="pdlp",
+                                    base_solver=mp_solver,
+                                    deadline_ms=120_000.0)
+                                break
+                            except Exception:
+                                # the chosen replica's process is gone:
+                                # the pump loop's poll runs fail-stop
+                                # containment, then the retry re-routes
+                                # onto survivors
+                                time.sleep(0.05)
+                        with hlock:
+                            n_submitted[0] += 1
+                            if h is None:
+                                submit_failures[0] += 1
+                            else:
+                                handles.append(h)
+
+                threads = [threading.Thread(target=_submitter,
+                                            args=(k,), daemon=True)
+                           for k in range(MP_THREADS)]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                t_stop = t0 + 120.0
+                t_report = t0 + 5.0
+                killed = kill_at is None
+                while time.monotonic() < t_stop:
+                    with hlock:
+                        n_sub = n_submitted[0]
+                        snap = list(handles)
+                    if not killed and n_sub >= kill_at:
+                        kill_proc.send_signal(_signal.SIGKILL)
+                        killed = True
+                    router.poll()
+                    try:
+                        router.flush_all()
+                    except Exception:
+                        pass
+                    if (n_sub >= MP_N
+                            and not any(t.is_alive() for t in threads)
+                            and all(h.done() for h in snap)):
+                        break
+                    if time.monotonic() >= t_report:
+                        t_report += 5.0
+                        print(f"[mp:{arm}] t={time.monotonic() - t0:.1f}s"
+                              f" sub={n_sub}"
+                              f" done={sum(1 for h in snap if h.done())}"
+                              f"/{len(snap)}"
+                              f" threads={sum(t.is_alive() for t in threads)}"
+                              f" failovers={router.failovers}",
+                              file=sys.stderr, flush=True)
+                    time.sleep(0.02)
+                elapsed = time.monotonic() - t0
+                for t in threads:
+                    t.join(timeout=5.0)
+                done = sum(1 for h in handles if h.done())
+                lost = router.rehome_lost + submit_failures[0]
+                print(f"[mp:{arm}] finished el={elapsed:.2f}s done={done}"
+                      f" hung={len(handles) - done}"
+                      f" submit_failures={submit_failures[0]}"
+                      f" failovers={router.failovers}"
+                      f" rehomed={router.rehomed}"
+                      f" rehome_lost={router.rehome_lost}",
+                      file=sys.stderr, flush=True)
+                return (elapsed, done, len(handles) - done,
+                        router.failovers, router.rehomed, lost)
+
+            # 1000 ms heartbeat silence: the one-attempt ping has a
+            # 100 ms deadline, and on a loaded single-core box a live
+            # worker can miss a few — only sustained silence (a real
+            # process death) should fail over
+            mp_opts = FleetOptions(n_replicas=3,
+                                   heartbeat_timeout_ms=1000.0,
+                                   gossip_interval_s=2.0)
+
+            r1 = connect_fleet(endpoints["a"],
+                               options=FleetOptions(n_replicas=1))
+            el1, done1, _hung1, _f, _r, _l = _drive(r1, arm="1w")
+            r1.drain()
+
+            r3 = connect_fleet(endpoints["b"], options=mp_opts)
+            el3, done3, _hung3, _f, _r, _l = _drive(r3, arm="3w")
+            r3.drain()
+
+            # in-process A/B twin: same modeled per-request time, same
+            # fleet policy and submitter concurrency, one process —
+            # isolates the wire's own overhead (3w remote vs this)
+            def _mp_make_service(replica_id, journal_dir):
+                return SolveService(
+                    ServeOptions(max_batch=MP_BATCH, max_wait_ms=5.0,
+                                 plan=_modeled_plan(MP_SERVICE_MS)),
+                    clock=time.monotonic, journal_dir=journal_dir)
+
+            rin = FleetRouter(mp_opts, clock=time.monotonic,
+                              make_service=_mp_make_service)
+            elin, donein, _hungin, _f, _r, _l = _drive(rin, arm="in")
+            rin.drain()
+
+            rk = connect_fleet(endpoints["k"], options=mp_opts)
+            victim = by_group["k"][0]
+            (elk, donek, hungk, failoversk, rehomedk,
+             lostk) = _drive(rk, kill_at=MP_N // 2, kill_proc=victim,
+                             arm="kill")
+            rk.drain()
+
+            tp1w = done1 / el1 if el1 else None
+            tp3w = done3 / el3 if el3 else None
+            tpin = donein / elin if elin else None
+            out["multiproc_fleet"] = {
+                "n_requests": MP_N,
+                "n_workers": 3,
+                "service_ms": MP_SERVICE_MS,
+                "solves_per_sec_1w": round(tp1w, 2) if tp1w else None,
+                "solves_per_sec_3w": round(tp3w, 2) if tp3w else None,
+                "solves_per_sec_inproc": (round(tpin, 2)
+                                          if tpin else None),
+                "multihost_scaling_efficiency": (
+                    round((tp3w / 3.0) / tp1w, 4)
+                    if tp1w and tp3w else None),
+                "remote_lost_request_rate": (
+                    round((hungk + lostk) / MP_N, 6)),
+                "failovers": failoversk,
+                "rehomed": rehomedk,
+                "hung": hungk,
+                "requests_done_kill": donek,
+            }
+    except Exception as exc:
+        out["multiproc_fleet_bench_error"] = str(exc)[:120]
+    finally:
+        for p in mp_procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for p in mp_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        if mp_root is not None:
+            import shutil
+
+            shutil.rmtree(mp_root, ignore_errors=True)
 
     # ---- extras (accelerator only; the CPU fallback exists to report
     # a headline quickly, not to grind PDHG on one core) ---------------

@@ -184,6 +184,18 @@ REGISTERED_FLAGS = {
     "between online predictor refits, ticked from SolveService.poll "
     "— never the submit hot path (learn.train.default_refit_every; "
     "default 64)",
+    "NET_PORT": "default TCP port for `python -m dispatches_tpu.net "
+    "--worker` (net.__main__; 0 = kernel-assigned ephemeral port, "
+    "printed on the ready line; `--port` wins over the flag)",
+    "NET_CONNECT_TIMEOUT_MS": "RPC client connection-dial timeout in "
+    "milliseconds (net.rpc.RpcClient; default 500)",
+    "NET_RPC_RETRIES": "RPC client retry budget per call on transport "
+    "errors, with capped-exponential backoff between attempts "
+    "(net.rpc.RpcClient; default 2; 0 = fail on first error)",
+    "NET_HEARTBEAT_MS": "deadline for a remote replica's heartbeat "
+    "ping RPC — never retried: a missed ping is a lost beat the "
+    "router's timeout logic must see (fleet.remote.RemoteReplicaHandle; "
+    "default 100)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -147,6 +147,8 @@ CORPUS: Dict[str, Dict[str, str]] = {
             soak = os.environ.get("DISPATCHES_TPU_SOAK_SPEC_PATH")
             cool = os.environ.get("DISPATCHES_TPU_OBS_FLIGHT_COOLDOWN")
             pred = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_N")
+            nret = os.environ.get("DISPATCHES_TPU_NET_RETRIES")
+            nhb = os.environ.get("DISPATCHES_TPU_NET_HEARTBEAT_TIMEOUT_MS")
         """,
         "good": """
             import os
@@ -192,6 +194,10 @@ CORPUS: Dict[str, Dict[str, str]] = {
             wpred = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT")
             wphid = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_HIDDEN")
             wpref = os.environ.get("DISPATCHES_TPU_WARMSTART_PREDICT_REFIT_N")
+            nport = os.environ.get("DISPATCHES_TPU_NET_PORT")
+            nct = os.environ.get("DISPATCHES_TPU_NET_CONNECT_TIMEOUT_MS")
+            nrr = os.environ.get("DISPATCHES_TPU_NET_RPC_RETRIES")
+            nhb = os.environ.get("DISPATCHES_TPU_NET_HEARTBEAT_MS")
         """,
     },
     "GL008": {

@@ -25,6 +25,20 @@ site                    where it fires
 ``router.submit``       :meth:`fleet.FleetRouter.submit` — the request
                         is refused at the fleet façade and completes
                         as ``SHED`` (contained)
+``net.connect``         :class:`net.rpc.RpcClient` connection dial —
+                        the dial fails (label = ``host:port`` peer, so
+                        ``match`` partitions one peer away; a
+                        persistent rule is a network partition)
+``net.send``            request framing onto a connected socket — the
+                        write fails and the connection is torn down
+                        (label = ``peer/method``; retry/backoff
+                        contains it, ``hang_s`` models added latency
+                        consumed against the call's deadline clock)
+``net.recv``            response framing off the socket — the read
+                        fails after the request may already have been
+                        delivered (label = ``peer/method``; same
+                        containment and ``hang_s`` semantics as
+                        ``net.send``)
 ======================  ====================================================
 
 A **scenario** is a list of rules.  The string grammar (also accepted
@@ -56,9 +70,11 @@ Rule fields:
                 poison a deterministic subset of soak traffic.
 ``skew_s``      ``service.clock`` only: seconds added to the service's
                 clock reads while the rule has fire budget.
-``hang_s``      plan sites only: the fence *wedges* for this many
-                seconds instead of raising — the plan consumes the
-                duration via its injectable clock, so a fence watchdog
+``hang_s``      plan and ``net.*`` sites: the fence (or RPC) *wedges*
+                for this many seconds instead of raising — the plan
+                consumes the duration via its injectable clock (the
+                RPC client charges it against the call's deadline
+                budget), so a fence watchdog
                 (``PlanOptions.fence_timeout_ms``) can be proven to
                 escape a hang rather than wait it out.  Non-raising
                 like ``skew_s``: hang firings count in ``faults.hung``,
@@ -120,6 +136,9 @@ SITES = (
     "service.clock",
     "replica.heartbeat",
     "router.submit",
+    "net.connect",
+    "net.send",
+    "net.recv",
 )
 
 _UNLIMITED = None  # sentinel for "no fire budget"

@@ -19,9 +19,24 @@ of relearning it.
 ``n_replicas == 1`` (the default) is a pure pass-through: no gossip,
 no heartbeat machinery, bitwise-identical behaviour to a bare
 SolveService.
+
+The replicas need not share the router's process:
+:class:`~dispatches_tpu.fleet.remote.RemoteReplicaHandle` presents the
+same handle surface over RPC to a
+``python -m dispatches_tpu.net --worker`` process
+(:func:`~dispatches_tpu.fleet.remote.connect_fleet` wires a whole
+fleet of them), and the router routes/sheds/heartbeat-failovers over
+it unchanged — journal handoff re-homes a killed worker's open
+requests from its journal directory on a shared filesystem
+(``docs/net.md``).
 """
 from dispatches_tpu.fleet.gossip import Gossip
 from dispatches_tpu.fleet.handoff import RehomeResult, rehome
+from dispatches_tpu.fleet.remote import (
+    RemoteReplicaHandle,
+    RemoteServiceFacade,
+    connect_fleet,
+)
 from dispatches_tpu.fleet.replica import ReplicaHandle
 from dispatches_tpu.fleet.router import FleetOptions, FleetRouter
 
@@ -30,6 +45,9 @@ __all__ = [
     "FleetRouter",
     "Gossip",
     "RehomeResult",
+    "RemoteReplicaHandle",
+    "RemoteServiceFacade",
     "ReplicaHandle",
+    "connect_fleet",
     "rehome",
 ]

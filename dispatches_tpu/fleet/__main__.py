@@ -1,0 +1,119 @@
+"""CLI: ``python -m dispatches_tpu.fleet --stats [--json]``.
+
+Drives a small self-contained demo workload through a multi-replica
+:class:`~dispatches_tpu.fleet.FleetRouter` on a virtual clock (the
+stub model — one tiny XLA program per lane count) and prints the
+fleet-tier operator view: aggregate counters plus the per-replica
+routing/health block (``fleet_stats``).  With ``--json`` the raw
+metrics dict is printed instead (one JSON line, BENCH-style).
+
+CI smoke-runs both modes in the gates job, so this surface staying
+importable and runnable is part of the contract.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+
+def _render_text(metrics: dict) -> str:
+    fleet = metrics["fleet"]
+    lines = [
+        "fleet stats",
+        "===========",
+        f"replicas          {fleet['alive']}/{fleet['n_replicas']} alive",
+        f"submitted         {metrics['submitted']}",
+        f"solved            {metrics['solved']}",
+        f"timeouts          {metrics['timeouts']}",
+        f"errors            {metrics['errors']}",
+        f"shed              {metrics['shed']} "
+        f"(fleet rung: {fleet['fleet_shed']})",
+        f"queue depth       {metrics['queue_depth']}",
+        f"batches           {metrics['batches']}",
+        f"programs          {metrics['programs']} "
+        f"(compiles: {metrics['compile_count']})",
+        f"failovers         {fleet['failovers']} "
+        f"(rehomed: {fleet['rehomed']}, lost: {fleet['rehome_lost']})",
+    ]
+    gossip = fleet.get("gossip")
+    if gossip is not None:
+        lines.append(f"gossip            {gossip['exchanges']} rounds, "
+                     f"{gossip['entries_merged']} entries merged")
+    warm = metrics.get("warm_start")
+    if warm is not None:
+        lines.append(f"warm-start        hit rate "
+                     f"{warm['hit_rate']:.2f} (size {warm['size']})")
+    lines.append("")
+    lines.append("per replica")
+    lines.append("-----------")
+    for name, per in fleet["per_replica"].items():
+        state = "alive" if per["alive"] else "dead"
+        lines.append(
+            f"{name:<14} {state:<6} gen {per['generation']} "
+            f"beats {per['beats']} (lost {per['beats_lost']}) "
+            f"submitted {per['submitted']} solved {per['solved']} "
+            f"depth {per['queue_depth']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dispatches_tpu.fleet",
+        description="replicated solve-tier demo / stats report")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the text stats report (default action)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw metrics dict as one JSON line")
+    ap.add_argument("--n", type=int, default=48,
+                    help="demo requests (default 48)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size (default 2)")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ns = ap.parse_args(argv)
+
+    import numpy as np
+
+    from dispatches_tpu.fleet import FleetOptions, FleetRouter
+    from dispatches_tpu.obs.soak import FakeClock, StubNLP, make_stub_solver
+    from dispatches_tpu.serve.service import ServeOptions, SolveService
+
+    clock = FakeClock()
+    options = FleetOptions(n_replicas=ns.replicas, gossip_interval_s=0.5)
+
+    def make_service(replica_id, journal_dir):
+        return SolveService(ServeOptions(max_batch=ns.max_batch,
+                                         max_wait_ms=5.0),
+                            clock=clock, journal_dir=journal_dir)
+
+    router = FleetRouter(options, clock=clock, make_service=make_service)
+    nlp = StubNLP()
+    base_solver = make_stub_solver()
+    base = nlp.default_params()
+    handles = []
+    for i in range(ns.n):
+        params = {"p": {"price": np.asarray(base["p"]["price"])
+                        * (1.0 + 0.001 * i)},
+                  "fixed": {}}
+        handles.append(router.submit(nlp, params, solver="pdlp",
+                                     base_solver=base_solver))
+        clock.advance(0.01)
+        router.poll()
+    router.flush_all()
+    router.poll()
+    metrics = router.metrics()
+    hung = sum(1 for h in handles if not h.done())
+    if ns.json:
+        metrics["hung"] = hung
+        print(json.dumps(metrics, default=str))
+    else:
+        print(_render_text(metrics))
+        if hung:
+            print(f"\nWARNING: {hung} handles never reached a "
+                  "terminal status")
+    return 1 if hung else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

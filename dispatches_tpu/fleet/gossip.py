@@ -8,6 +8,14 @@ a crash-recovery snapshot would carry: in production gossip crosses a
 process boundary, so the exchange must survive encode → decode, and
 reusing the codec keeps one schema for both paths.
 
+The donate/merge halves are replica methods
+(:meth:`ReplicaHandle.gossip_donate` / ``gossip_adopt``) backed by the
+module-level :func:`donate_states` / :func:`merge_bucket_state` — so a
+:class:`fleet.remote.RemoteReplicaHandle` can override the pair with
+``gossip_donate``/``gossip_merge`` RPCs while the worker process applies
+the *same* merge functions to its local service.  :class:`Gossip` only
+schedules rounds and moves the states between replicas.
+
 Merging is additive and conservative:
 
 * warm-start index entries are adopted only when the recipient's index
@@ -32,7 +40,7 @@ Merging is additive and conservative:
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -40,9 +48,105 @@ from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.serve import journal as journal_mod
 from dispatches_tpu.serve import snapshot as snapshot_mod
 
-__all__ = ["Gossip"]
+__all__ = ["Gossip", "donate_states", "merge_bucket_state"]
 
 DEFAULT_INTERVAL_S = 5.0
+
+
+def donate_states(service) -> Dict[str, dict]:
+    """One replica's donation: ``{bucket label: snapshot bucket state}``
+    (JSON-safe — already encoded through the snapshot codec)."""
+    buckets: Dict[str, dict] = {}
+    for bucket in service._buckets.values():
+        try:
+            buckets[bucket.stats.label] = snapshot_mod._bucket_state(bucket)
+        except Exception:
+            continue  # an unencodable bucket skips this round
+    return buckets
+
+
+def merge_bucket_state(service, label: str, state: dict) -> int:
+    """Fold one donated bucket state into ``service``; returns how
+    many warm-index entries were adopted."""
+    bucket = next((b for b in service._buckets.values()
+                   if b.stats.label == label), None)
+    if bucket is None:
+        # recipient has not formed this bucket yet: stash through
+        # the snapshot-restore path, applied by _bucket_for on
+        # first formation (setdefault: an earlier donor wins the
+        # round, next round refreshes)
+        service._restored_buckets.setdefault(label, state)
+        return 0
+    adopted = _merge_index(bucket, state.get("warm_index"))
+    est_state = state.get("est")
+    est = getattr(bucket, "est", None)
+    if (est_state is not None and est is not None
+            and est.samples == 0 and int(est_state["samples"]) > 0):
+        # cold adoption only: own samples always win
+        try:
+            est.samples = int(est_state["samples"])
+            snapshot_mod._restore_p2(est._p95, est_state["p2"])
+        except Exception:
+            pass
+    _merge_predictor(bucket, state.get("predictor"))
+    return adopted
+
+
+def _merge_predictor(bucket, pred_state) -> None:
+    """Most-trained-wins predictor adoption: a replica takes the
+    donor's fitted weights only when the donor has seen strictly
+    more training samples — replicas serving the same stream
+    converge on the best-trained model without averaging (weights
+    fitted on different replay windows do not mix)."""
+    trainer = getattr(bucket, "predict_trainer", None)
+    if (trainer is None or pred_state is None
+            or getattr(bucket, "predict_fallback", False)):
+        return
+    try:
+        donated = journal_mod.decode_tree(pred_state)
+        donor_trained = int(donated.get("trained_samples", 0))
+        if donor_trained <= trainer.trained_samples:
+            return
+        from dispatches_tpu.learn.predictor import StartPredictor
+
+        pred = StartPredictor.from_state(donated.get("predictor"))
+        if pred is None:
+            return
+        trainer.adopt(pred, donor_trained)
+        bucket.predict_weights = dict(pred.params)
+    except Exception:
+        return  # a malformed donation must never take a replica down
+
+
+def _merge_index(bucket, index_state) -> int:
+    index = getattr(bucket, "warm_index", None)
+    if index is None or index_state is None:
+        return 0
+    try:
+        donated = journal_mod.decode_tree(index_state)
+    except Exception:
+        return 0
+    vecs = donated.get("vecs")
+    if vecs is None:
+        return 0
+    keys = donated["keys"]
+    xs = donated["xs"]
+    zs = donated["zs"]
+    adopted = 0
+    for slot, key in enumerate(keys):
+        if isinstance(key, list):
+            key = tuple(key)
+        if key is None or index.exact(key) is not None:
+            continue
+        try:
+            index.add(key, np.asarray(vecs[slot], np.float64),
+                      xs[slot], zs[slot])
+        except ValueError:
+            # dimension mismatch: the donor's bucket label collided
+            # with a differently-shaped problem — refuse the lot
+            return adopted
+        adopted += 1
+    return adopted
 
 
 class Gossip:
@@ -74,29 +178,38 @@ class Gossip:
         return True
 
     def exchange(self) -> int:
-        """One all-pairs round; returns the number of entries merged."""
+        """One all-pairs round; returns the number of entries merged.
+
+        Donations and merges go through the replica handles
+        (``gossip_donate``/``gossip_adopt``), so a mixed fleet —
+        in-process and remote replicas behind one router — exchanges
+        state across process boundaries transparently."""
         live = [r for r in self._replicas
                 if r.alive and r.service is not None]
         if len(live) < 2:
             return 0
         donations = []
         for replica in live:
-            buckets = {}
-            for bucket in replica.service._buckets.values():
-                try:
-                    buckets[bucket.stats.label] = \
-                        snapshot_mod._bucket_state(bucket)
-                except Exception:
-                    continue  # an unencodable bucket skips this round
-            donations.append((replica, buckets))
+            try:
+                donations.append((replica, replica.gossip_donate()))
+            except Exception:
+                # an unreachable remote donates nothing this round; it
+                # can still adopt from the others below
+                donations.append((replica, {}))
         merged = 0
         for recipient, _ in donations:
+            # ordered donor-major pairs: every donor's state for a label
+            # is merged (the second donor may hold keys the first
+            # lacked), in deterministic replica order
+            pool = [(label, state)
+                    for donor, buckets in donations if donor is not recipient
+                    for label, state in buckets.items()]
             got = 0
-            for donor, buckets in donations:
-                if donor is recipient:
-                    continue
-                for label, state in buckets.items():
-                    got += self._merge(recipient.service, label, state)
+            if pool:
+                try:
+                    got = recipient.gossip_adopt(pool)
+                except Exception:
+                    got = 0  # unreachable recipient: skip this round
             if got:
                 self._obs_merged.inc(got, replica=recipient.name)
             merged += got
@@ -104,86 +217,3 @@ class Gossip:
         self.entries_merged += merged
         self._obs_rounds.inc()
         return merged
-
-    def _merge(self, service, label: str, state: dict) -> int:
-        """Fold one donated bucket state into ``service``; returns how
-        many warm-index entries were adopted."""
-        bucket = next((b for b in service._buckets.values()
-                       if b.stats.label == label), None)
-        if bucket is None:
-            # recipient has not formed this bucket yet: stash through
-            # the snapshot-restore path, applied by _bucket_for on
-            # first formation (setdefault: an earlier donor wins the
-            # round, next round refreshes)
-            service._restored_buckets.setdefault(label, state)
-            return 0
-        adopted = self._merge_index(bucket, state.get("warm_index"))
-        est_state = state.get("est")
-        est = getattr(bucket, "est", None)
-        if (est_state is not None and est is not None
-                and est.samples == 0 and int(est_state["samples"]) > 0):
-            # cold adoption only: own samples always win
-            try:
-                est.samples = int(est_state["samples"])
-                snapshot_mod._restore_p2(est._p95, est_state["p2"])
-            except Exception:
-                pass
-        self._merge_predictor(bucket, state.get("predictor"))
-        return adopted
-
-    @staticmethod
-    def _merge_predictor(bucket, pred_state) -> None:
-        """Most-trained-wins predictor adoption: a replica takes the
-        donor's fitted weights only when the donor has seen strictly
-        more training samples — replicas serving the same stream
-        converge on the best-trained model without averaging (weights
-        fitted on different replay windows do not mix)."""
-        trainer = getattr(bucket, "predict_trainer", None)
-        if (trainer is None or pred_state is None
-                or getattr(bucket, "predict_fallback", False)):
-            return
-        try:
-            donated = journal_mod.decode_tree(pred_state)
-            donor_trained = int(donated.get("trained_samples", 0))
-            if donor_trained <= trainer.trained_samples:
-                return
-            from dispatches_tpu.learn.predictor import StartPredictor
-
-            pred = StartPredictor.from_state(donated.get("predictor"))
-            if pred is None:
-                return
-            trainer.adopt(pred, donor_trained)
-            bucket.predict_weights = dict(pred.params)
-        except Exception:
-            return  # a malformed donation must never take a replica down
-
-    @staticmethod
-    def _merge_index(bucket, index_state) -> int:
-        index = getattr(bucket, "warm_index", None)
-        if index is None or index_state is None:
-            return 0
-        try:
-            donated = journal_mod.decode_tree(index_state)
-        except Exception:
-            return 0
-        vecs = donated.get("vecs")
-        if vecs is None:
-            return 0
-        keys = donated["keys"]
-        xs = donated["xs"]
-        zs = donated["zs"]
-        adopted = 0
-        for slot, key in enumerate(keys):
-            if isinstance(key, list):
-                key = tuple(key)
-            if key is None or index.exact(key) is not None:
-                continue
-            try:
-                index.add(key, np.asarray(vecs[slot], np.float64),
-                          xs[slot], zs[slot])
-            except ValueError:
-                # dimension mismatch: the donor's bucket label collided
-                # with a differently-shaped problem — refuse the lot
-                return adopted
-            adopted += 1
-        return adopted

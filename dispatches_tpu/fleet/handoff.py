@@ -69,7 +69,10 @@ def rehome(router, dead) -> "RehomeResult":
             lost += 1
             continue
         rehomed += 1
-        router._track(survivor, twin, nlp, base_solver)
+        router._track(survivor, twin, nlp, base_solver,
+                      params=rec["params"], solver=rec["solver"],
+                      options=rec["options"],
+                      deadline_ms=rec["deadline_ms"])
         if tracked is not None:
             router._bridge(twin, tracked.handle)
     return RehomeResult(len(replayed.open_requests), rehomed, lost)

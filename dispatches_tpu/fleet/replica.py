@@ -112,6 +112,29 @@ class ReplicaHandle:
                 best = val
         return best
 
+    # -- gossip ------------------------------------------------------------
+
+    def gossip_donate(self) -> dict:
+        """This replica's donation for one gossip round:
+        ``{bucket label: snapshot bucket state}`` (JSON-safe).  A
+        remote handle overrides this with a ``gossip_donate`` RPC."""
+        if not self.alive or self.service is None:
+            return {}
+        from dispatches_tpu.fleet import gossip as gossip_mod
+
+        return gossip_mod.donate_states(self.service)
+
+    def gossip_adopt(self, pairs) -> int:
+        """Merge ordered ``(label, state)`` donations into this
+        replica's service; returns warm-index entries adopted.  A
+        remote handle overrides this with a ``gossip_merge`` RPC."""
+        if not self.alive or self.service is None:
+            return 0
+        from dispatches_tpu.fleet import gossip as gossip_mod
+
+        return sum(gossip_mod.merge_bucket_state(self.service, label, state)
+                   for label, state in pairs)
+
     # -- lifecycle ---------------------------------------------------------
 
     def kill(self) -> None:

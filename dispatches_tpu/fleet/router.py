@@ -34,6 +34,7 @@ either order.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import os
 import random
@@ -134,14 +135,24 @@ class _FleetShedHandle:
 class _Tracked:
     """What the router remembers per in-flight request — enough to
     re-home it (nlp/base_solver are not journaled; they are live
-    objects) and to bridge its handle after a failover."""
+    objects) and to bridge its handle after a failover.  The submit
+    arguments ride along too: a result that went terminal at a remote
+    worker but died undelivered in its done-buffer is *closed* in the
+    journal, so replay cannot rescue it — only the router's own copy
+    of the request can."""
 
-    __slots__ = ("handle", "nlp", "base_solver")
+    __slots__ = ("handle", "nlp", "base_solver", "params", "solver",
+                 "options", "deadline_ms")
 
-    def __init__(self, handle, nlp, base_solver):
+    def __init__(self, handle, nlp, base_solver, params=None,
+                 solver=None, options=None, deadline_ms=None):
         self.handle = handle
         self.nlp = nlp
         self.base_solver = base_solver
+        self.params = params
+        self.solver = solver
+        self.options = options
+        self.deadline_ms = deadline_ms
 
 
 class FleetRouter:
@@ -159,7 +170,20 @@ class FleetRouter:
     def __init__(self, options: Optional[FleetOptions] = None, *,
                  clock: Callable[[], float] = time.monotonic,
                  make_service: Optional[Callable] = None,
-                 durable_dir: Optional[str] = None):
+                 durable_dir: Optional[str] = None,
+                 replicas: Optional[List[ReplicaHandle]] = None):
+        if replicas is not None:
+            # caller-built handles (e.g. fleet.remote.connect_fleet):
+            # they own their services and journal dirs; the router's
+            # replica count follows the handles, everything else —
+            # routing, shed, heartbeat failover, gossip — is identical
+            if not replicas:
+                raise ValueError("replicas must be non-empty when given")
+            if options is None:
+                options = FleetOptions.from_env(n_replicas=len(replicas))
+            elif options.n_replicas != len(replicas):
+                options = dataclasses.replace(
+                    options, n_replicas=len(replicas))
         self.options = options if options is not None else FleetOptions.from_env()
         if self.options.n_replicas < 1:
             raise ValueError(
@@ -169,21 +193,25 @@ class FleetRouter:
         # guards the router's own maps only — never held across a
         # replica service call (see module docstring)
         self._lock = sanitized_lock("fleet.router")
-        if durable_dir is None and self._multi:
+        if durable_dir is None and self._multi and replicas is None:
             durable_dir = tempfile.mkdtemp(prefix="dispatches-fleet-")
         self.durable_dir = durable_dir
-        if make_service is None:
-            def make_service(replica_id, journal_dir):
-                return SolveService(clock=clock, journal_dir=journal_dir)
-        self._replicas: List[ReplicaHandle] = []
-        for i in range(self.options.n_replicas):
-            journal_dir = None
-            if durable_dir is not None:
-                journal_dir = os.path.join(durable_dir, f"replica-{i:02d}")
-            self._replicas.append(ReplicaHandle(
-                i, make_service(i, journal_dir), journal_dir=journal_dir,
-                clock=clock,
-                heartbeat_timeout_ms=self.options.heartbeat_timeout_ms))
+        if replicas is not None:
+            self._replicas = list(replicas)
+        else:
+            if make_service is None:
+                def make_service(replica_id, journal_dir):
+                    return SolveService(clock=clock, journal_dir=journal_dir)
+            self._replicas = []
+            for i in range(self.options.n_replicas):
+                journal_dir = None
+                if durable_dir is not None:
+                    journal_dir = os.path.join(durable_dir,
+                                               f"replica-{i:02d}")
+                self._replicas.append(ReplicaHandle(
+                    i, make_service(i, journal_dir),
+                    journal_dir=journal_dir, clock=clock,
+                    heartbeat_timeout_ms=self.options.heartbeat_timeout_ms))
         self._by_id = {r.replica_id: r for r in self._replicas}
         self._rng = random.Random(self.options.seed)
         #: (replica_id, request_id) -> _Tracked, pruned as handles finish
@@ -280,7 +308,9 @@ class FleetRouter:
             self._default_nlp = nlp
             self._default_base_solver = base_solver
             self._tracked[(replica.replica_id, handle.request_id)] = \
-                _Tracked(handle, nlp, base_solver)
+                _Tracked(handle, nlp, base_solver, params=params,
+                         solver=solver, options=options,
+                         deadline_ms=deadline_ms)
         return handle
 
     def _refuse(self, params, now, deadline_at) -> _FleetShedHandle:
@@ -412,7 +442,54 @@ class FleetRouter:
         self.rehome_lost += result.lost
         if result.rehomed:
             self._obs_rehomed.inc(result.rehomed, replica=replica.name)
+        self._resolve_stranded(replica)
         self._update_gauges()
+
+    def _resolve_stranded(self, dead: ReplicaHandle) -> None:
+        """Re-solve requests the journal considers closed but whose
+        client handle never got the result.
+
+        Journal replay only re-homes requests that were still *open*
+        on the dead replica's books.  A wire-tier worker can complete
+        a request (journal it terminal) and then die with the result
+        sitting undelivered in its done-buffer — replay skips it, yet
+        the caller's handle would hang forever.  Every tracked entry
+        for the dead replica that survives ``rehome``'s pops and is
+        not ``done()`` is exactly that case (or a request whose accept
+        never hit the journal before the crash): resubmit it from the
+        router's own copy of the request and bridge the orphan.
+        Solvers are deterministic, so the twin reproduces the lost
+        result; handle complete-once keeps delivery exactly-once."""
+        with self._lock:
+            mine = [key for key in self._tracked
+                    if key[0] == dead.replica_id]
+            stranded = [self._tracked.pop(key) for key in mine]
+        stranded = [t for t in stranded if not t.handle.done()]
+        resolved = lost = 0
+        for tracked in stranded:
+            survivor = self._pick_survivor()
+            if survivor is None or tracked.nlp is None:
+                lost += 1
+                continue
+            try:
+                twin = survivor.service.submit(
+                    tracked.nlp, tracked.params, solver=tracked.solver,
+                    options=tracked.options,
+                    deadline_ms=tracked.deadline_ms,
+                    base_solver=tracked.base_solver)
+            except Exception:
+                lost += 1
+                continue
+            resolved += 1
+            self._track(survivor, twin, tracked.nlp,
+                        tracked.base_solver, params=tracked.params,
+                        solver=tracked.solver, options=tracked.options,
+                        deadline_ms=tracked.deadline_ms)
+            self._bridge(twin, tracked.handle)
+        self.rehomed += resolved
+        self.rehome_lost += lost
+        if resolved:
+            self._obs_rehomed.inc(resolved, replica=dead.name)
 
     # -- handoff plumbing (called by fleet.handoff) ------------------------
 
@@ -423,10 +500,13 @@ class FleetRouter:
                                      None)
 
     def _track(self, replica: ReplicaHandle, handle, nlp,
-               base_solver) -> None:
+               base_solver, params=None, solver=None, options=None,
+               deadline_ms=None) -> None:
         with self._lock:
             self._tracked[(replica.replica_id, handle.request_id)] = \
-                _Tracked(handle, nlp, base_solver)
+                _Tracked(handle, nlp, base_solver, params=params,
+                         solver=solver, options=options,
+                         deadline_ms=deadline_ms)
 
     def _bridge(self, twin, orphan) -> None:
         with self._lock:

@@ -1,0 +1,13 @@
+"""Wire transport + RPC for the multi-process fleet tier.
+
+* :mod:`~dispatches_tpu.net.wire` — length-prefixed framed messages
+  with the bitwise pytree payload codec;
+* :mod:`~dispatches_tpu.net.rpc` — request/response RPC with per-call
+  deadlines, retry/backoff, and ``net.*`` fault sites;
+* :mod:`~dispatches_tpu.net.worker` — the
+  ``python -m dispatches_tpu.net --worker`` process hosting a real
+  SolveService behind the RPC server.
+
+Heavy imports (the worker pulls in the serve stack and JAX) stay out
+of this package init; import the submodule you need.
+"""

@@ -1,0 +1,6 @@
+"""``python -m dispatches_tpu.net`` — fleet worker entry point."""
+import sys
+
+from dispatches_tpu.net.worker import main
+
+sys.exit(main())

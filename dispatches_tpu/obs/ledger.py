@@ -138,6 +138,14 @@ GATED_METRICS = {
     # no-hang contract is exactly zero
     "fleet_scaling_efficiency": +1,
     "replica_lost_request_rate": -1,
+    # bench multiproc_fleet section (ISSUE 19): solves/s per process of
+    # 3 worker PROCESSES over 1 on identical streams (wire + RPC +
+    # cross-process failover tax), and the kill arm's fraction of
+    # accepted requests that never reached a terminal status after a
+    # SIGKILL'd worker's journal re-homed across process boundaries —
+    # the cross-process no-hang contract is exactly zero
+    "multihost_scaling_efficiency": +1,
+    "remote_lost_request_rate": -1,
 }
 
 _GIT_SHA: Optional[str] = None

@@ -85,12 +85,41 @@ def default_dir() -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 
+def _dtype_code(dtype: "np.dtype") -> str:
+    """A string that :func:`_resolve_dtype` can reconstruct *exactly*.
+
+    ``dtype.str`` is the canonical choice, but numpy renders extension
+    dtypes (ml_dtypes ``bfloat16``, ``float8_*``) as opaque void codes
+    (``'<V2'``) that round-trip into raw-void arrays, silently dropping
+    the dtype class.  Those are encoded by registered *name* instead —
+    ``'bfloat16'`` — which ml_dtypes resolves back to the real thing."""
+    if dtype.kind == "V" and dtype.names is None:
+        return dtype.name
+    return dtype.str
+
+
+def _resolve_dtype(code: str) -> "np.dtype":
+    """Inverse of :func:`_dtype_code` (ml_dtypes lookup for the names
+    numpy itself cannot resolve; lazy import keeps this module
+    importable without it)."""
+    try:
+        return np.dtype(code)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, code))
+
+
 def _encode_leaf(leaf) -> Dict:
-    arr = np.ascontiguousarray(np.asarray(leaf))
+    arr = np.asarray(leaf)
+    if not arr.flags["C_CONTIGUOUS"]:
+        # NOT ascontiguousarray unconditionally: it promotes 0-d
+        # arrays to shape (1,), which would decode one rank off
+        arr = np.ascontiguousarray(arr)
     return {
         "__nd__": [
             list(arr.shape),
-            arr.dtype.str,
+            _dtype_code(arr.dtype),
             base64.b64encode(arr.tobytes()).decode("ascii"),
         ]
     }
@@ -116,7 +145,7 @@ def decode_tree(obj):
         if "__nd__" in obj:
             shape, dtype, b64 = obj["__nd__"]
             buf = base64.b64decode(b64.encode("ascii"))
-            return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(
+            return np.frombuffer(buf, dtype=_resolve_dtype(dtype)).reshape(
                 tuple(shape)).copy()
         if "__tuple__" in obj:
             return tuple(decode_tree(v) for v in obj["__tuple__"])

@@ -17,7 +17,7 @@ import pytest
 from dispatches_tpu.obs import ledger
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREVIEW = os.path.join(REPO_ROOT, "BENCH_r14_cpu_preview.json")
+PREVIEW = os.path.join(REPO_ROOT, "BENCH_r15_cpu_preview.json")
 
 
 @pytest.fixture(scope="module")
@@ -78,6 +78,11 @@ def test_preview_record_passes_schema(bench):
         assert key in out["fleet"]
     for key in bench.FLEET_NONNULL_KEYS:
         assert out["fleet"][key] is not None
+    # the multi-process fleet A/B (r15, ISSUE 19): headline measured
+    for key in bench.MULTIPROC_FLEET_KEYS:
+        assert key in out["multiproc_fleet"]
+    for key in bench.MULTIPROC_FLEET_NONNULL_KEYS:
+        assert out["multiproc_fleet"][key] is not None
     # the adaptive-scheduler A/B (r12, ISSUE 14)
     for key in bench.SCHED_KEYS:
         assert key in out["scheduler"]
@@ -443,6 +448,19 @@ def test_validate_rejects_missing_keys(bench):
     out = json.load(open(PREVIEW))
     del out["fleet"]
     bench.validate_bench_output(out)
+    # multiproc_fleet (ISSUE 19): optional-but-complete, headlines
+    # non-null when the section is present
+    out = json.load(open(PREVIEW))
+    del out["multiproc_fleet"]["multihost_scaling_efficiency"]
+    with pytest.raises(ValueError, match="multihost_scaling_efficiency"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    out["multiproc_fleet"]["remote_lost_request_rate"] = None
+    with pytest.raises(ValueError, match="must be measured"):
+        bench.validate_bench_output(out)
+    out = json.load(open(PREVIEW))
+    del out["multiproc_fleet"]
+    bench.validate_bench_output(out)
     # scheduler (r12): optional-but-complete, both arms carry the full
     # per-arm key set
     out = json.load(open(PREVIEW))
@@ -529,6 +547,33 @@ def test_preview_fleet_section(bench):
     assert fleet["replica_lost_request_rate"] == 0.0
     assert fleet["hung"] == 0
     assert 0 < fleet["requests_done_kill"] <= fleet["n_requests"]
+
+
+def test_preview_multiproc_fleet_section(bench):
+    """The ISSUE-19 multi-process fleet A/B backs the wire-tier
+    acceptance: real worker processes behind RemoteReplicaHandles on
+    loopback, modeled per-request service time paid inside each worker
+    — 3 workers deliver at least 0.6x per-worker parity with the
+    1-worker serial baseline (multihost_scaling_efficiency), and the
+    SIGKILL-one arm drives every accepted request terminal through
+    cross-process journal re-homing (remote_lost_request_rate exactly
+    0, zero hung handles)."""
+    out = json.load(open(PREVIEW))
+    mp = out["multiproc_fleet"]
+    assert mp["n_requests"] > 0
+    assert mp["n_workers"] == 3
+    assert mp["service_ms"] > 0
+    assert 0.0 < mp["solves_per_sec_1w"] < mp["solves_per_sec_3w"]
+    assert mp["multihost_scaling_efficiency"] == pytest.approx(
+        mp["solves_per_sec_3w"] / (3 * mp["solves_per_sec_1w"]),
+        abs=5e-4)
+    # the ISSUE-19 acceptance floor
+    assert mp["multihost_scaling_efficiency"] >= 0.6
+    assert mp["failovers"] == 1
+    assert mp["rehomed"] > 0
+    assert mp["remote_lost_request_rate"] == 0.0
+    assert mp["hung"] == 0
+    assert 0 < mp["requests_done_kill"] <= mp["n_requests"]
 
 
 def test_bench_record_round_trips_through_ledger(bench, tmp_path):

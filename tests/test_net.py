@@ -1,0 +1,512 @@
+"""Wire transport + multi-process fleet (ISSUE 19).
+
+Pins the net-tier contracts:
+
+* **framing** — length-prefixed versioned frames round-trip over a
+  socketpair; bad magic / version skew / mid-frame close all fail
+  loudly as ``WireError``; a clean EOF at a frame boundary is ``None``;
+* **payload codec** — arrays cross bitwise (the journal codec),
+  including the hardened corners: 0-d arrays keep rank 0, ml_dtypes
+  bfloat16 keeps its dtype class, empty arrays keep shape and dtype;
+  namedtuples keep their field names;
+* **RPC** — per-call deadlines (injected ``hang_s`` delay is charged
+  against the budget without sleeping), capped-exponential retry
+  absorbing transient ``net.*`` faults, persistent partitions
+  surfacing after the budget, remote handler errors never retried,
+  and seeded scenario determinism (same scenario → same outcomes);
+* **remote fleet** — 4 concurrent submitters through a FleetRouter
+  over RemoteReplicaHandles to 2 real worker processes under
+  ``DISPATCHES_TPU_SANITIZE=1``: every request exactly-once terminal,
+  zero lock-order inversions;
+* **single-replica parity** (slow lane) — a 1-worker remote fleet
+  returns bitwise-identical results to an in-process SolveService on
+  the same stream.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.faults import inject as faults
+from dispatches_tpu.net import wire
+from dispatches_tpu.net.rpc import (
+    RpcClient,
+    RpcDeadline,
+    RpcError,
+    RpcRemoteError,
+    RpcServer,
+)
+from dispatches_tpu.serve import journal as journal_mod
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def test_wire_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, {"m": "x", "p": [1, 2, 3]})
+        wire.send_msg(a, {"m": "y"})
+        assert wire.recv_msg(b) == {"m": "x", "p": [1, 2, 3]}
+        assert wire.recv_msg(b) == {"m": "y"}
+        a.close()
+        assert wire.recv_msg(b) is None  # clean EOF at frame boundary
+    finally:
+        b.close()
+
+
+def test_wire_bad_magic_and_version_refused():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"HTTP/1.1 200 OK\r\n\r\n")
+        with pytest.raises(wire.WireError, match="magic"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        body = b"{}"
+        a.sendall(wire.MAGIC + bytes([wire.WIRE_VERSION + 1])
+                  + len(body).to_bytes(4, "big") + body)
+        with pytest.raises(wire.WireError, match="version"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_midframe_close_is_an_error():
+    a, b = socket.socketpair()
+    try:
+        frame_start = wire.MAGIC + bytes([wire.WIRE_VERSION])
+        a.sendall(frame_start + (100).to_bytes(4, "big") + b"partial")
+        a.close()
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_wire_oversize_frame_refused():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire.MAGIC + bytes([wire.WIRE_VERSION])
+                  + (wire.MAX_FRAME + 1).to_bytes(4, "big"))
+        with pytest.raises(wire.WireError, match="MAX_FRAME"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# payload codec (journal codec hardening + namedtuple extension)
+# ---------------------------------------------------------------------------
+
+
+def _json_roundtrip(tree):
+    encoded = json.loads(json.dumps(wire.encode_payload(tree)))
+    return wire.decode_payload(encoded)
+
+
+def test_codec_zero_d_array_keeps_rank():
+    out = _json_roundtrip({"x": np.array(3.5)})
+    assert out["x"].shape == ()
+    assert out["x"].dtype == np.float64
+    assert out["x"].tobytes() == np.array(3.5).tobytes()
+
+
+def test_codec_bfloat16_keeps_dtype_class():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    arr = np.array([1.5, -2.25, 0.0], dtype=ml_dtypes.bfloat16)
+    out = _json_roundtrip(arr)
+    assert out.dtype == arr.dtype
+    assert out.tobytes() == arr.tobytes()
+    # 0-d bf16: both hardened paths at once
+    scalar = np.array(1.25, dtype=ml_dtypes.bfloat16)
+    back = _json_roundtrip(scalar)
+    assert back.shape == () and back.dtype == scalar.dtype
+    assert back.tobytes() == scalar.tobytes()
+
+
+def test_codec_empty_arrays_keep_shape_and_dtype():
+    for arr in (np.zeros((0,), np.float32), np.zeros((3, 0), np.int64)):
+        out = _json_roundtrip(arr)
+        assert out.shape == arr.shape
+        assert out.dtype == arr.dtype
+
+
+def test_codec_noncontiguous_input_roundtrips():
+    base = np.arange(12, dtype=np.float64).reshape(3, 4)
+    sliced = base[:, ::2]
+    out = _json_roundtrip(sliced)
+    assert out.shape == sliced.shape
+    assert np.array_equal(out, sliced)
+
+
+def test_journal_codec_same_hardening():
+    """The journal's own encode/decode (no wire superset) carries the
+    same hardened corners — snapshots and gossip ride it directly."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    tree = {"zero_d": np.array(7), "bf16": np.ones(4, ml_dtypes.bfloat16),
+            "empty": np.zeros((0, 2), np.float32),
+            "tup": (np.array(1.0), "label")}
+    encoded = json.loads(json.dumps(journal_mod.encode_tree(tree)))
+    out = journal_mod.decode_tree(encoded)
+    assert out["zero_d"].shape == ()
+    assert out["bf16"].dtype == tree["bf16"].dtype
+    assert out["empty"].shape == (0, 2)
+    assert isinstance(out["tup"], tuple) and out["tup"][1] == "label"
+
+
+def test_codec_namedtuple_fields_survive():
+    from collections import namedtuple
+
+    Res = namedtuple("Res", ["obj", "iters"])
+    out = _json_roundtrip({"r": Res(np.float64(2.5), np.int32(7))})
+    assert out["r"]._fields == ("obj", "iters")
+    assert float(out["r"].obj) == 2.5
+    assert int(out["r"].iters) == 7
+
+
+# ---------------------------------------------------------------------------
+# RPC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def echo_server():
+    calls = {"n": 0}
+
+    def echo(payload):
+        calls["n"] += 1
+        return {"got": payload}
+
+    def boom(payload):
+        raise ValueError("handler exploded")
+
+    server = RpcServer({"echo": echo, "boom": boom}).start()
+    server.calls = calls
+    yield server
+    server.stop()
+
+
+def test_rpc_roundtrip_and_ping(echo_server):
+    client = RpcClient("127.0.0.1", echo_server.port)
+    try:
+        out = client.call("echo", {"x": np.arange(3, dtype=np.float32),
+                                   "t": (1, "two")})
+        assert out["got"]["t"] == (1, "two")
+        assert out["got"]["x"].dtype == np.float32
+        assert client.ping()
+    finally:
+        client.close()
+
+
+def test_rpc_remote_errors_never_retry(echo_server):
+    client = RpcClient("127.0.0.1", echo_server.port, retries=3)
+    try:
+        with pytest.raises(RpcRemoteError, match="handler exploded"):
+            client.call("boom")
+        with pytest.raises(RpcRemoteError, match="unknown RPC method"):
+            client.call("nope")
+        assert echo_server.calls["n"] == 0
+    finally:
+        client.close()
+
+
+def test_rpc_injected_delay_burns_deadline_without_sleeping(echo_server):
+    client = RpcClient("127.0.0.1", echo_server.port, retries=0)
+    faults.arm({"rules": [{"site": "net.recv", "hang_s": 30.0,
+                           "p": 1.0, "times": 0}]})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcDeadline):
+            client.call("echo", {}, deadline_ms=50.0)
+        assert time.monotonic() - t0 < 2.0  # virtual, not slept
+    finally:
+        client.close()
+
+
+def test_rpc_transient_fault_absorbed_by_retry(echo_server):
+    client = RpcClient("127.0.0.1", echo_server.port,
+                       retries=2, backoff_ms=1.0)
+    r0 = faults.recovered_total()
+    faults.arm({"rules": [{"site": "net.send", "p": 1.0}]})  # times=1
+    try:
+        assert client.call("echo", {"ok": 1})["got"]["ok"] == 1
+        assert faults.recovered_total() > r0  # retry noted the recovery
+    finally:
+        client.close()
+
+
+def test_rpc_persistent_partition_exhausts_budget(echo_server):
+    peer = f"127.0.0.1:{echo_server.port}"
+    client = RpcClient("127.0.0.1", echo_server.port,
+                       retries=1, backoff_ms=1.0)
+    faults.arm({"rules": [{"site": "net.connect", "p": 1.0, "times": 0,
+                           "match": peer}]})
+    try:
+        with pytest.raises(RpcError):
+            client.call("echo", {})
+    finally:
+        client.close()
+
+
+def test_rpc_fault_scenario_is_deterministic(echo_server):
+    """Same seeded scenario, same call sequence → identical outcome
+    sequence, twice (the PR-13 determinism contract at net.* sites)."""
+
+    def run_once():
+        faults.reset()
+        faults.arm({"rules": [{"site": "net.send", "p": 0.5, "seed": 11,
+                               "times": 0}]})
+        client = RpcClient("127.0.0.1", echo_server.port,
+                           retries=0, backoff_ms=1.0)
+        outcomes = []
+        for i in range(8):
+            try:
+                client.call("echo", {"i": i})
+                outcomes.append("ok")
+            except RpcError:
+                outcomes.append("err")
+        client.close()
+        faults.reset()
+        return outcomes
+
+    first, second = run_once(), run_once()
+    assert first == second
+    assert "err" in first and "ok" in first  # p=0.5 actually mixes
+
+
+def test_early_delivered_result_waits_for_its_submit():
+    """A poll on one pooled connection can deliver a result BEFORE the
+    submit RPC that created it returns (batch=1 workers complete the
+    request inside the submit window).  The facade must stash the
+    early result and complete the handle when submit materialises it —
+    ack-and-drop would lose the result forever."""
+    from dispatches_tpu.fleet.remote import RemoteServiceFacade
+
+    def submit(payload):
+        return {"id": 7, "bucket": "b", "queue_depth": 0}
+
+    def poll(payload):
+        acked = set((payload or {}).get("ack") or [])
+        if 7 in acked:  # a real worker never re-delivers past its ack
+            return {"dispatched": 0, "done": []}
+        return {"dispatched": 0,
+                "done": [{"id": 7, "status": "DONE",
+                          "result": {"x": np.float32(3.5)},
+                          "obj": 1.25, "latency_ms": 2.0}]}
+
+    server = RpcServer({"submit": submit, "poll": poll}).start()
+    client = RpcClient("127.0.0.1", server.port)
+    try:
+        facade = RemoteServiceFacade(client, {"options": {}})
+        facade.poll()  # the result for id 7 lands with no handle yet
+        handle = facade.submit(None, {"p": 1.0})  # submit says: id 7
+        assert handle.done()
+        assert handle.result().status == "DONE"
+        facade.poll()  # ack consumed: nothing re-delivered, no leak
+        assert facade._early == {}
+        assert facade._acks == []
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-process fleet
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(tmp_path, idx, env):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dispatches_tpu.net", "--worker",
+         "--port", "0", "--journal-dir", str(tmp_path / f"w{idx}"),
+         "--model", "stub", "--max-batch", "8", "--max-wait-ms", "5",
+         "--tick-ms", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    ready = json.loads(proc.stdout.readline())
+    assert ready.get("ready") and ready.get("port")
+    return proc, ready["port"]
+
+
+def test_threaded_submitters_two_workers_sanitized(tmp_path, monkeypatch):
+    """4 concurrent submitters through one FleetRouter over
+    RemoteReplicaHandles to 2 worker processes, lock sanitizer armed:
+    every request reaches exactly one terminal status, and the runtime
+    lock-order report shows zero inversions."""
+    monkeypatch.setenv("DISPATCHES_TPU_SANITIZE", "1")
+    from dispatches_tpu.analysis import runtime as runtime_mod
+    from dispatches_tpu.fleet import FleetOptions, connect_fleet
+    from dispatches_tpu.obs.soak import StubNLP
+
+    runtime_mod.reset_lock_order()
+    env = dict(os.environ, DISPATCHES_TPU_SANITIZE="1")
+    workers = [_spawn_worker(tmp_path, i, env) for i in range(2)]
+    try:
+        router = connect_fleet(
+            [("127.0.0.1", port) for _, port in workers],
+            options=FleetOptions(n_replicas=2, heartbeat_timeout_ms=2000.0,
+                                 gossip_interval_s=0.5))
+        nlp = StubNLP()
+        base = nlp.default_params()
+        per_thread = 12
+        results = [[] for _ in range(4)]
+        errors = []
+
+        def submitter(k):
+            try:
+                handles = []
+                for i in range(per_thread):
+                    price = np.asarray(base["p"]["price"]) \
+                        * (1.0 + 0.01 * k + 0.001 * i)
+                    handles.append(router.submit(
+                        nlp, {"p": {"price": price}, "fixed": {}},
+                        solver="pdlp", deadline_ms=60_000.0))
+                for handle in handles:
+                    results[k].append(handle.result(timeout=60.0))
+            except Exception as exc:  # pragma: no cover - diagnostic
+                errors.append(exc)
+
+        threads = [threading.Thread(target=submitter, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        t_end = time.monotonic() + 90.0
+        while any(t.is_alive() for t in threads) \
+                and time.monotonic() < t_end:
+            router.poll()
+            time.sleep(0.005)
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not errors, errors
+        flat = [r for rs in results for r in rs]
+        assert len(flat) == 4 * per_thread
+        assert all(r.status == "DONE" for r in flat), \
+            {r.status for r in flat}
+        report = runtime_mod.lock_order_report()
+        assert report["inversions"] == [], report["inversions"]
+        # the net-tier locks actually participated in the run
+        held = set(report["holds"])
+        assert any(name.startswith("net.") for name in held), held
+    finally:
+        for proc, _ in workers:
+            proc.kill()
+        for proc, _ in workers:
+            proc.wait(timeout=10)
+
+
+def test_sigkill_failover_rehomes_open_requests(tmp_path):
+    """Kill -9 one of two workers mid-stream: heartbeat silence →
+    journal handoff across process boundaries → every accepted request
+    still reaches a terminal status (zero lost, zero hung)."""
+    import signal as signal_mod
+
+    from dispatches_tpu.fleet import FleetOptions, connect_fleet
+    from dispatches_tpu.obs.soak import StubNLP
+
+    env = dict(os.environ)
+    workers = [_spawn_worker(tmp_path, i, env) for i in range(2)]
+    try:
+        router = connect_fleet(
+            [("127.0.0.1", port) for _, port in workers],
+            options=FleetOptions(n_replicas=2,
+                                 heartbeat_timeout_ms=300.0,
+                                 gossip_interval_s=10.0))
+        nlp = StubNLP()
+        base = nlp.default_params()
+        handles = []
+        for i in range(40):
+            price = np.asarray(base["p"]["price"]) * (1.0 + 0.001 * i)
+            for attempt in (0, 1):
+                try:
+                    handles.append(router.submit(
+                        nlp, {"p": {"price": price}, "fixed": {}},
+                        solver="pdlp", deadline_ms=60_000.0))
+                    break
+                except Exception:
+                    if attempt:
+                        raise
+                    router.poll()  # fail-stop containment, re-route
+            if i == 20:
+                workers[0][0].send_signal(signal_mod.SIGKILL)
+            router.poll()
+            time.sleep(0.002)
+        t_end = time.monotonic() + 60.0
+        while (router.failovers == 0
+               or not all(h.done() for h in handles)) \
+                and time.monotonic() < t_end:
+            router.poll()
+            try:
+                router.flush_all()
+            except Exception:
+                pass
+            time.sleep(0.01)
+        assert router.failovers == 1
+        assert router.rehome_lost == 0
+        hung = sum(1 for h in handles if not h.done())
+        assert hung == 0
+        assert all(h.status in ("DONE", "TIMEOUT") for h in handles)
+    finally:
+        for proc, _ in workers:
+            proc.kill()
+        for proc, _ in workers:
+            proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_single_replica_remote_parity(tmp_path):
+    """A 1-worker remote fleet is bitwise-identical to an in-process
+    SolveService on the same stub stream (the ISSUE 19 parity gate:
+    the wire codec must not perturb a single bit of the results)."""
+    from dispatches_tpu.fleet import FleetOptions, connect_fleet
+    from dispatches_tpu.obs.soak import StubNLP, make_stub_solver
+    from dispatches_tpu.serve import ServeOptions, SolveService
+
+    env = dict(os.environ)
+    proc, port = _spawn_worker(tmp_path, 0, env)
+    try:
+        router = connect_fleet([("127.0.0.1", port)],
+                               options=FleetOptions(n_replicas=1))
+        local = SolveService(ServeOptions(max_batch=8, max_wait_ms=5.0),
+                             clock=time.monotonic)
+        nlp = StubNLP()
+        solver = make_stub_solver()
+        base = nlp.default_params()
+        for i in range(6):
+            params = {"p": {"price": np.asarray(base["p"]["price"])
+                            * (1.0 + 0.01 * i)}, "fixed": {}}
+            remote_h = router.submit(nlp, params, solver="pdlp")
+            local_h = local.submit(nlp, params, solver="pdlp",
+                                   base_solver=solver)
+            remote_res = remote_h.result(timeout=30.0)
+            local_res = local_h.result(timeout=30.0)
+            assert remote_res.status == local_res.status == "DONE"
+            assert float(remote_res.obj) == float(local_res.obj)
+            for field in local_res.result._fields:
+                a = np.asarray(getattr(remote_res.result, field))
+                b = np.asarray(getattr(local_res.result, field))
+                assert a.tobytes() == b.tobytes(), field
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
