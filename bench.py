@@ -403,6 +403,10 @@ FLEET_NONNULL_KEYS = ("fleet_scaling_efficiency",
 #: ``remote_lost_request_rate`` is the kill arm's fraction of accepted
 #: requests that never reached a terminal status (gated, lower is
 #: better; the cross-process no-hang contract is exactly 0).
+#: ``wire_overhead_ms`` (optional key — older committed previews lack
+#: it) is the measured per-request wire tax: p50/p99 of the client's
+#: submit RPC latency minus the count-weighted worker-side handler
+#: latency, pulled via the ``metrics_snapshot`` RPC.
 MULTIPROC_FLEET_KEYS = (
     "n_requests", "n_workers", "service_ms",
     "solves_per_sec_1w", "solves_per_sec_3w", "solves_per_sec_inproc",
@@ -666,6 +670,11 @@ def _finalize_output(out):
         if mp.get("remote_lost_request_rate") is not None:
             metrics["remote_lost_request_rate"] = \
                 mp["remote_lost_request_rate"]
+        # wire tax trend (ungated: loopback p99 on a loaded CPU box is
+        # noisy; the record keeps the trajectory honest)
+        wo = mp.get("wire_overhead_ms") or {}
+        if wo.get("p99") is not None:
+            metrics["wire_overhead_p99_ms"] = wo["p99"]
         ledger.append(ledger.make_record(
             "bench", out.get("metric", "bench"), metrics,
             backend=out.get("backend"),
@@ -2029,6 +2038,44 @@ def run_bench():
 
             r3 = connect_fleet(endpoints["b"], options=mp_opts)
             el3, done3, _hung3, _f, _r, _l = _drive(r3, arm="3w")
+
+            def _wire_overhead(router):
+                """Measured wire tax per submit: client-observed RPC
+                latency quantiles minus the count-weighted worker-side
+                handler latency (``net.rpc.server_ms``, pulled via the
+                ``metrics_snapshot`` RPC).  The client histogram spans
+                every remote arm driven so far, but all arms carry the
+                same modeled service time, so the difference isolates
+                framing + codec + kernel/socket transit.  Needs no
+                tracing armed — works off the always-on RPC metrics."""
+                from dispatches_tpu.obs import registry as _obs_reg
+
+                snap = _obs_reg.default_registry().snapshot()
+                client = (((snap.get("net.rpc_ms") or {}).get("values")
+                           or {}).get("method=submit"))
+                if not client:
+                    return None
+                tot = w50 = w99 = 0.0
+                for s in router.replica_snapshots().values():
+                    srv = (((s.get("net.rpc.server_ms") or {})
+                            .get("values") or {}).get("method=submit"))
+                    if not srv or not srv.get("count"):
+                        continue
+                    c = float(srv["count"])
+                    tot += c
+                    w50 += c * float(srv.get("p50", 0.0))
+                    w99 += c * float(srv.get("p99", 0.0))
+                if tot <= 0:
+                    return None
+                return {
+                    "p50": round(max(
+                        float(client.get("p50", 0.0)) - w50 / tot, 0.0), 3),
+                    "p99": round(max(
+                        float(client.get("p99", 0.0)) - w99 / tot, 0.0), 3),
+                }
+
+            # pull before drain: metrics_snapshot needs live workers
+            wire_overhead = _wire_overhead(r3)
             r3.drain()
 
             # in-process A/B twin: same modeled per-request time, same
@@ -2072,6 +2119,9 @@ def run_bench():
                 "rehomed": rehomedk,
                 "hung": hungk,
                 "requests_done_kill": donek,
+                # optional key (not in MULTIPROC_FLEET_KEYS): older
+                # committed previews predate it
+                "wire_overhead_ms": wire_overhead,
             }
     except Exception as exc:
         out["multiproc_fleet_bench_error"] = str(exc)[:120]

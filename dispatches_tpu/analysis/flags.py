@@ -196,6 +196,18 @@ REGISTERED_FLAGS = {
     "ping RPC — never retried: a missed ping is a lost beat the "
     "router's timeout logic must see (fleet.remote.RemoteReplicaHandle; "
     "default 100)",
+    "NET_TRACE": "arm wire-level distributed tracing: RpcClient "
+    "attaches a trace context (request id, origin pid/generation, "
+    "parent span) to every frame, RpcServer opens child spans under "
+    "it, and workers record spans for trace_export pulls "
+    "(obs.distributed.enabled; disarmed = one cached-boolean branch "
+    "on the RPC hot path)",
+    "OBS_FLEET_EXPORT_DIR": "arm the fleet-mode continuous exporter in "
+    "this directory: the FleetRouter's ContinuousExporter merges live "
+    "remote-replica registry snapshots (process-labeled) into one "
+    "metrics.prom alongside the router's own series "
+    "(fleet.FleetRouter / obs.export.ContinuousExporter; unset = "
+    "per-process export only)",
 }
 
 _PREFIX = "DISPATCHES_TPU_"

@@ -198,6 +198,8 @@ CORPUS: Dict[str, Dict[str, str]] = {
             nct = os.environ.get("DISPATCHES_TPU_NET_CONNECT_TIMEOUT_MS")
             nrr = os.environ.get("DISPATCHES_TPU_NET_RPC_RETRIES")
             nhb = os.environ.get("DISPATCHES_TPU_NET_HEARTBEAT_MS")
+            ntr = os.environ.get("DISPATCHES_TPU_NET_TRACE")
+            fexp = os.environ.get("DISPATCHES_TPU_OBS_FLEET_EXPORT_DIR")
         """,
     },
     "GL008": {

@@ -45,6 +45,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from dispatches_tpu.analysis.runtime import sanitized_lock
 from dispatches_tpu.net import rpc as rpc_mod
+from dispatches_tpu.obs import distributed as obs_distributed
+from dispatches_tpu.obs import flight as obs_flight
+from dispatches_tpu.obs import trace as obs_trace
 from dispatches_tpu.serve.service import RequestStatus, ServeResult
 from dispatches_tpu.fleet.replica import (
     DEFAULT_HEARTBEAT_TIMEOUT_MS,
@@ -80,7 +83,8 @@ class RemoteSolveHandle:
     ``params``, ``submitted_at``, ``deadline_at``)."""
 
     __slots__ = ("_facade", "params", "submitted_at", "deadline_at",
-                 "request_id", "bucket_label", "_result")
+                 "request_id", "bucket_label", "_result", "_t_submit_us",
+                 "_rid")
 
     def __init__(self, facade, params, submitted_at, deadline_at,
                  request_id, bucket_label):
@@ -91,6 +95,15 @@ class RemoteSolveHandle:
         self.request_id = request_id
         self.bucket_label = bucket_label
         self._result: Optional[ServeResult] = None
+        # tracer-clock submit timestamp (None when tracing is disarmed);
+        # the facade emits a retroactive fleet.request span from it when
+        # the terminal result lands, bracketing the whole remote journey
+        self._t_submit_us: Optional[float] = None
+        # the wire-unique submit rid: worker-assigned int request ids
+        # restart at 1 per worker, so in a MERGED trace only this
+        # string keys one journey unambiguously (workers stamp it onto
+        # their spans as origin_rid at trace_export time)
+        self._rid: Optional[str] = None
 
     @property
     def status(self) -> str:
@@ -162,12 +175,24 @@ class RemoteServiceFacade:
             params = nlp.default_params()
         rid = (f"{self._client.peer}/{id(self):x}/"
                f"{time.monotonic_ns():x}-{next(self._rid_seq)}")
+        payload = {
+            "rid": rid, "params": params, "x0": x0, "solver": solver,
+            "options": options, "deadline_ms": deadline_ms,
+            "warm_key": warm_key,
+        }
+        traced = obs_distributed.enabled()
+        t_submit_us = obs_trace.now_us() if traced else None
         try:
-            resp = self._client.call("submit", {
-                "rid": rid, "params": params, "x0": x0, "solver": solver,
-                "options": options, "deadline_ms": deadline_ms,
-                "warm_key": warm_key,
-            }, deadline_ms=self.rpc_deadline_ms)
+            if traced:
+                # the wire context of this call (and any retry) carries
+                # the submit rid, so the worker's spans for this request
+                # name the router-side identity
+                with obs_distributed.submit_context(rid):
+                    resp = self._client.call(
+                        "submit", payload, deadline_ms=self.rpc_deadline_ms)
+            else:
+                resp = self._client.call(
+                    "submit", payload, deadline_ms=self.rpc_deadline_ms)
         except rpc_mod.RpcRemoteError as exc:
             # e.g. "service is draining": the same RuntimeError contract
             # the in-process service has
@@ -178,6 +203,8 @@ class RemoteServiceFacade:
         handle = RemoteSolveHandle(
             self, params, now, deadline_at, int(resp["id"]),
             resp.get("bucket", "remote"))
+        handle._t_submit_us = t_submit_us
+        handle._rid = rid
         with self._lock:
             early = self._early.pop(handle.request_id, None)
             if early is None:
@@ -186,7 +213,7 @@ class RemoteServiceFacade:
         if early is not None:
             # a concurrent poll beat us to the result — complete the
             # handle now instead of registering it for delivery
-            handle._complete(early)
+            self._finish(handle, early)
         return handle
 
     def poll(self, now: Optional[float] = None) -> int:
@@ -254,7 +281,66 @@ class RemoteServiceFacade:
             if "est_service_s" in resp:
                 self._est_s = resp["est_service_s"]
         for handle, result in completions:
-            handle._complete(result)
+            self._finish(handle, result)
+
+    def _finish(self, handle: RemoteSolveHandle,
+                result: ServeResult) -> None:
+        """Terminal bookkeeping for a completed handle — shared by the
+        delivery pump and the early-result path in ``submit`` (a
+        request the worker finished inside the submit RPC window still
+        needs its router-side envelope span)."""
+        handle._complete(result)
+        t0 = handle._t_submit_us
+        if t0 is not None and obs_trace.enabled():
+            # one envelope span per remote request on the ROUTER's
+            # clock: the worker's serve.* spans for the same
+            # request_id nest inside it in the merged trace
+            obs_trace.complete(
+                "fleet.request", t0, obs_trace.now_us() - t0,
+                request_id=handle.request_id,
+                origin_rid=handle._rid,
+                bucket=handle.bucket_label, peer=self._client.peer,
+                status=result.status)
+        if result.status == RequestStatus.TIMEOUT:
+            self._flight_deadline(handle, result)
+
+    def _flight_deadline(self, handle: RemoteSolveHandle,
+                         result: ServeResult) -> None:
+        """Router-side deadline-miss bundle carrying the implicated
+        worker's metrics snapshot (best-effort, never raises)."""
+        if not obs_flight.enabled():
+            return
+        try:
+            obs_flight.trigger(
+                "deadline_miss",
+                request_id=handle.request_id,
+                bucket=handle.bucket_label,
+                detail={"peer": self._client.peer,
+                        "latency_ms": result.latency_ms,
+                        "replica_snapshot": self.metrics_snapshot()})
+        except Exception:
+            pass  # diagnostics must never take down delivery
+
+    # -- fleet telemetry pull ------------------------------------------------
+
+    def metrics_snapshot(self) -> Optional[Dict]:
+        """The worker's full registry snapshot (+ pid/generation/clock
+        sample); None on any failure — telemetry pulls are best-effort
+        and never raise into routing or diagnostics paths."""
+        try:
+            return self._client.call("metrics_snapshot",
+                                     deadline_ms=2_000.0, retries=0)
+        except Exception:
+            return None
+
+    def trace_export(self, limit: int = 0) -> Optional[Dict]:
+        """Tail of the worker's trace ring (``limit=0`` = whole ring);
+        None on any failure."""
+        try:
+            return self._client.call("trace_export", {"limit": int(limit)},
+                                     deadline_ms=10_000.0, retries=0)
+        except Exception:
+            return None
 
     def close(self) -> None:
         self._client.close()
@@ -271,8 +357,11 @@ class RemoteReplicaHandle(ReplicaHandle):
                  client: Optional["rpc_mod.RpcClient"] = None):
         self._client = (client if client is not None
                         else rpc_mod.RpcClient(host, port))
+        self.endpoint = f"{host}:{int(port)}"
+        t_send_us = obs_trace.now_us()
         hello = self._client.call("hello",
                                   deadline_ms=rpc_deadline_ms)
+        t_recv_us = obs_trace.now_us()
         facade = RemoteServiceFacade(self._client, hello,
                                      rpc_deadline_ms=rpc_deadline_ms)
         if journal_dir is None:
@@ -283,6 +372,15 @@ class RemoteReplicaHandle(ReplicaHandle):
                          clock=clock,
                          heartbeat_timeout_ms=heartbeat_timeout_ms)
         self.generation = facade.generation
+        # real worker identity (not just the endpoint string) — fleet
+        # stats and per-replica metric labels carry these
+        self.worker_pid = facade.remote_pid
+        # clock-offset estimate from the hello exchange itself (the
+        # midpoint method); refresh_clock() tightens it over pings
+        self.clock_sync: Optional[obs_distributed.ClockSync] = None
+        if hello.get("now_us") is not None:
+            self.clock_sync = obs_distributed.offset_from_exchange(
+                t_send_us, t_recv_us, hello["now_us"])
 
     # -- health ------------------------------------------------------------
 
@@ -307,6 +405,37 @@ class RemoteReplicaHandle(ReplicaHandle):
         if not self.alive or self.service is None:
             return None
         return self.service.est_service_s()
+
+    # -- fleet telemetry ----------------------------------------------------
+
+    def refresh_clock(self, samples: int = 3) -> Optional[
+            obs_distributed.ClockSync]:
+        """Tighten the clock-offset estimate with ``samples`` ping
+        exchanges (lowest RTT wins, including the hello-time estimate);
+        keeps the previous estimate on total failure."""
+        if not self.alive or self.service is None:
+            return self.clock_sync
+        est = obs_distributed.sync_clock(
+            lambda: self._client.call("ping", deadline_ms=1_000.0,
+                                      retries=0),
+            samples=samples)
+        if est is not None and (self.clock_sync is None
+                                or est.rtt_us < self.clock_sync.rtt_us):
+            self.clock_sync = est
+        return self.clock_sync
+
+    def metrics_snapshot(self) -> Optional[Dict]:
+        """Best-effort pull of the worker's registry snapshot (None when
+        dead or unreachable — never raises)."""
+        if not self.alive or self.service is None:
+            return None
+        return self.service.metrics_snapshot()
+
+    def trace_export(self, limit: int = 0) -> Optional[Dict]:
+        """Best-effort pull of the worker's trace-ring tail."""
+        if not self.alive or self.service is None:
+            return None
+        return self.service.trace_export(limit=limit)
 
     # -- gossip ------------------------------------------------------------
 

@@ -251,6 +251,22 @@ class FleetRouter:
             "pending requests per replica (label=replica)")
         self._obs_alive = obs_registry.gauge(
             "fleet.replicas_alive", "live replicas behind the router")
+        # fleet-mode continuous export: when OBS_FLEET_EXPORT_DIR is
+        # set, one metrics.prom merges the router's registry with live
+        # remote-replica snapshots (process-labeled).  Disarmed, poll
+        # pays one `is None` check.
+        self._exporter = None
+        exp_dir = os.environ.get(flag_name("OBS_FLEET_EXPORT_DIR"), "")
+        if exp_dir:
+            try:
+                from dispatches_tpu.obs import export as obs_export
+
+                self._exporter = obs_export.ContinuousExporter(
+                    obs_export.ExportOptions.from_env(directory=exp_dir),
+                    clock=clock,
+                    fleet_snapshots=self.replica_snapshots)
+            except Exception:
+                self._exporter = None  # telemetry never blocks serving
         self._update_gauges()
 
     # -- introspection -----------------------------------------------------
@@ -372,11 +388,14 @@ class FleetRouter:
                 continue
             try:
                 n += replica.service.poll(now)
-            except Exception:
+            except Exception as exc:
                 # fail-stop containment: a poll that escaped the plan's
                 # retry/bisection/watchdog domains means the replica is
                 # wedged — treat it as crashed; the heartbeat timeout
-                # below turns that into a failover
+                # below turns that into a failover.  Bundle the evidence
+                # (including the replica's own metrics, reachable only
+                # until the kill closes its client) first.
+                self._flight_poll_error(replica, exc)
                 replica.kill()
         if self._multi:
             for replica in self._replicas:
@@ -387,7 +406,32 @@ class FleetRouter:
         self._pump_bridges()
         self._prune_tracked()
         self._update_gauges()
+        if self._exporter is not None:
+            self._exporter.maybe_export(now)
         return n
+
+    @staticmethod
+    def _flight_poll_error(replica: ReplicaHandle, exc: Exception) -> None:
+        """Router-side plan_error bundle for a fail-stopped replica,
+        carrying that replica's metrics snapshot when it can still be
+        pulled (remote handles expose ``metrics_snapshot``; in-process
+        ones share the router's registry).  Best-effort, never raises."""
+        from dispatches_tpu.obs import flight as obs_flight
+
+        if not obs_flight.enabled():
+            return
+        try:
+            puller = getattr(replica, "metrics_snapshot", None)
+            snapshot = puller() if callable(puller) else None
+            obs_flight.trigger(
+                "plan_error",
+                label=replica.name,
+                detail={"replica": replica.name,
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "worker_pid": getattr(replica, "worker_pid", None),
+                        "replica_snapshot": snapshot})
+        except Exception:
+            pass  # diagnostics must never break containment
 
     def flush_all(self) -> int:
         """Drain every live replica's pending queue; returns how many
@@ -555,15 +599,69 @@ class FleetRouter:
 
     # -- telemetry ---------------------------------------------------------
 
+    def replica_snapshots(self) -> Dict[str, Dict]:
+        """Live remote replicas' registry snapshots, keyed by a
+        ``<name>:pid<pid>`` process label — the fleet exporter's and
+        trace merger's pull source.  Replicas without a
+        ``metrics_snapshot`` surface (in-process handles share the
+        router's registry already) and failed pulls are skipped."""
+        out: Dict[str, Dict] = {}
+        for replica in self._replicas:
+            puller = getattr(replica, "metrics_snapshot", None)
+            if not callable(puller):
+                continue
+            snap = puller()
+            if not snap:
+                continue
+            pid = snap.get("pid", getattr(replica, "worker_pid", None))
+            out[f"{replica.name}:pid{pid}"] = snap.get("snapshot") or {}
+        return out
+
+    def trace_exports(self, limit: int = 0) -> List[Dict]:
+        """Live remote replicas' trace rings, clock-aligned and shaped
+        for ``obs.distributed.merge_traces`` remotes.  Each pull first
+        refreshes the replica's clock-offset estimate (best effort);
+        replicas without a trace surface are skipped."""
+        out: List[Dict] = []
+        for replica in self._replicas:
+            puller = getattr(replica, "trace_export", None)
+            if not callable(puller):
+                continue
+            refresh = getattr(replica, "refresh_clock", None)
+            if callable(refresh):
+                try:
+                    refresh()
+                except Exception:
+                    pass
+            resp = puller(limit)
+            if not resp:
+                continue
+            sync = getattr(replica, "clock_sync", None)
+            out.append({
+                "pid": resp.get("pid"),
+                "label": replica.name,
+                "offset_us": 0.0 if sync is None else sync.offset_us,
+                "events": resp.get("events") or [],
+                "dropped": int(resp.get("dropped") or 0),
+            })
+        return out
+
     def fleet_stats(self) -> Dict:
         """The ``fleet`` telemetry block (also embedded by
         :meth:`metrics`)."""
         per = {}
         for replica in self._replicas:
             m = replica.metrics()
+            sync = getattr(replica, "clock_sync", None)
             per[replica.name] = {
                 "alive": replica.alive,
                 "generation": replica.generation,
+                # real worker identity (remote replicas record these
+                # from the hello; in-process replicas report None)
+                "pid": getattr(replica, "worker_pid", None),
+                "endpoint": getattr(replica, "endpoint", None),
+                "clock_offset_us": (None if sync is None
+                                    else round(sync.offset_us, 1)),
                 "beats": replica.beats,
                 "beats_lost": replica.beats_lost,
                 "submitted": None if m is None else m["submitted"],

@@ -30,9 +30,16 @@ All injected faults the retry loop absorbs are reported via
 :func:`faults.note_recovered`, keeping ``fault_recovery_rate == 1.0``
 when containment held.
 
-Instrumented: ``net.rpcs{method,status}``, ``net.retries``, a
-``net.rpc_ms`` latency histogram, and retroactive ``net.rpc`` trace
-spans (:func:`obs.trace.complete`) when tracing is armed.
+Instrumented: ``net.rpc.calls{method,outcome}``, ``net.retries``,
+``net.connects`` (fresh dials — reconnect churn), client-side
+``net.rpc_ms`` and server-side ``net.rpc.server_ms`` latency
+histograms (their difference is the measured wire overhead), and
+retroactive ``net.rpc`` trace spans (:func:`obs.trace.complete`) when
+tracing is armed.  When :mod:`obs.distributed` is armed
+(``DISPATCHES_TPU_NET_TRACE``) the client additionally attaches a
+compact trace context to every frame and the server re-hydrates it
+around the handler — disarmed, both sides pay one cached-boolean
+branch.
 """
 from __future__ import annotations
 
@@ -47,6 +54,7 @@ from dispatches_tpu.analysis.flags import flag_name
 from dispatches_tpu.analysis.runtime import sanitized_lock
 from dispatches_tpu.faults import inject as _faults
 from dispatches_tpu.net import wire
+from dispatches_tpu.obs import distributed as obs_distributed
 from dispatches_tpu.obs import registry as obs_registry
 from dispatches_tpu.obs import trace as obs_trace
 
@@ -67,15 +75,22 @@ DEFAULT_RETRIES = 2
 DEFAULT_BACKOFF_MS = 10.0
 BACKOFF_CAP_MS = 250.0
 
-_rpcs = obs_registry.counter(
-    "net.rpcs", "RPC calls completed by the client "
-    "(method=<name>, status=ok|remote_error|deadline|exhausted)")
+_calls = obs_registry.counter(
+    "net.rpc.calls", "RPC calls completed by the client "
+    "(method=<name>, outcome=ok|remote_error|deadline|exhausted)")
 _retries = obs_registry.counter(
     "net.retries", "RPC transport attempts retried after a "
     "dial/send/recv failure (method=<name>)")
+_connects = obs_registry.counter(
+    "net.connects", "fresh client dials (pool misses + reconnects "
+    "after torn connections; peer=<host:port>)")
 _latency = obs_registry.histogram(
-    "net.rpc_ms", "RPC round-trip latency in milliseconds "
-    "(method=<name>; successful calls only)")
+    "net.rpc_ms", "client-observed RPC round-trip latency in "
+    "milliseconds (method=<name>; successful calls only)")
+_server_latency = obs_registry.histogram(
+    "net.rpc.server_ms", "server-side handler latency in milliseconds "
+    "(method=<name>; successful dispatches only) — subtract from the "
+    "client's net.rpc_ms to get wire overhead")
 
 
 class RpcError(RuntimeError):
@@ -123,7 +138,11 @@ class RpcServer:
     def __init__(self, handlers: Dict[str, Callable], *,
                  host: str = "127.0.0.1", port: int = 0):
         self._handlers = dict(handlers)
-        self._handlers.setdefault("ping", lambda payload: {"pong": True})
+        # the clock sample rides the heartbeat: obs.distributed's
+        # midpoint estimator needs a remote now_us on every ping
+        self._handlers.setdefault(
+            "ping", lambda payload: {"pong": True,
+                                     "now_us": obs_trace.now_us()})
         # guards the live-connection set only; socket I/O and handler
         # dispatch run on the per-connection threads outside it
         self._lock = sanitized_lock("net.server")
@@ -187,14 +206,35 @@ class RpcServer:
         if handler is None:
             return {"id": rid, "ok": False, "kind": "method",
                     "error": f"unknown RPC method {method!r}"}
+        tc = msg.get("tc")
+        t0 = time.monotonic()
         try:
             payload = wire.decode_payload(msg.get("p"))
-            result = handler(payload)
+            if tc is not None and obs_distributed.enabled():
+                result = self._dispatch_traced(method, handler, payload, tc)
+            else:
+                result = handler(payload)
+            _server_latency.observe((time.monotonic() - t0) * 1e3,
+                                    method=method)
             return {"id": rid, "ok": True,
                     "p": wire.encode_payload(result)}
         except Exception as exc:  # handler bug → error response, not a
             return {"id": rid, "ok": False, "kind": "app",  # dead conn
                     "error": f"{type(exc).__name__}: {exc}"}
+
+    @staticmethod
+    def _dispatch_traced(method: str, handler: Callable, payload, tc: Dict):
+        """Run one handler under the caller's re-hydrated trace context
+        so spans it emits (and ``distributed.current()`` reads) carry
+        the origin-side request identity."""
+        with obs_distributed.remote_context(tc) as ctx:
+            args: Dict = {"method": method, "origin_pid": ctx.pid}
+            if ctx.rid is not None:
+                args["request_id"] = ctx.rid
+            if ctx.parent is not None:
+                args["origin_parent"] = ctx.parent
+            with obs_trace.span("net.rpc.serve", **args):
+                return handler(payload)
 
     def stop(self) -> None:
         self._running = False
@@ -264,6 +304,7 @@ class RpcClient:
             raise RpcConnectError(
                 f"dial {self.peer} failed: {exc}") from exc
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _connects.inc(peer=self.peer)
         return sock
 
     def _checkin(self, sock: socket.socket) -> None:
@@ -304,6 +345,9 @@ class RpcClient:
         rid = f"{self._nonce}-{next(self._seq)}"
         request = {"id": rid, "m": method,
                    "p": wire.encode_payload(payload)}
+        # one cached-boolean branch when disarmed (spy-pinned)
+        if obs_distributed.enabled():
+            request["tc"] = obs_distributed.wire_context()
         penalty_s = 0.0  # injected delay, charged as if time passed
         label = f"{self.peer}/{method}"
         attempt = 0
@@ -401,7 +445,7 @@ class RpcClient:
 
     def _finish(self, method: str, status: str, t0: float,
                 t0_us: float) -> None:
-        _rpcs.inc(method=method, status=status)
+        _calls.inc(method=method, outcome=status)
         dur_ms = (time.monotonic() - t0) * 1e3
         if status == "ok":
             _latency.observe(dur_ms, method=method)

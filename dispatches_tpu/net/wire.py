@@ -57,10 +57,10 @@ _HEADER = struct.Struct(">4sBI")
 #: enough that a corrupt length can't trigger a multi-GB allocation
 MAX_FRAME = 256 * 1024 * 1024
 
-_bytes_tx = obs_registry.counter(
-    "net.bytes_tx", "wire bytes written (frames; header + body)")
-_bytes_rx = obs_registry.counter(
-    "net.bytes_rx", "wire bytes read (frames; header + body)")
+_bytes = obs_registry.counter(
+    "net.bytes", "wire bytes moved (header + body), by direction")
+_frames = obs_registry.counter(
+    "net.frames", "wire frames moved, by direction")
 
 
 class WireError(RuntimeError):
@@ -132,7 +132,8 @@ def send_msg(sock: socket.socket, msg: Dict) -> int:
         raise WireError(f"frame body {len(body)} B exceeds MAX_FRAME")
     frame = _HEADER.pack(MAGIC, WIRE_VERSION, len(body)) + body
     sock.sendall(frame)
-    _bytes_tx.inc(len(frame))
+    _bytes.inc(len(frame), dir="tx")
+    _frames.inc(dir="tx")
     return len(frame)
 
 
@@ -169,7 +170,8 @@ def recv_msg(sock: socket.socket) -> Optional[Dict]:
     if length > MAX_FRAME:
         raise WireError(f"frame length {length} exceeds MAX_FRAME")
     body = _recv_exact(sock, length) if length else b""
-    _bytes_rx.inc(_HEADER.size + length)
+    _bytes.inc(_HEADER.size + length, dir="rx")
+    _frames.inc(dir="rx")
     try:
         return json.loads(body.decode("utf-8"))
     except ValueError as exc:

@@ -92,6 +92,8 @@ class WorkerHost:
                  host: str = "127.0.0.1", port: int = 0):
         from dispatches_tpu.analysis.runtime import sanitized_lock
         from dispatches_tpu.net import rpc as rpc_mod
+        from dispatches_tpu.obs import distributed as obs_distributed
+        from dispatches_tpu.obs import trace as obs_trace
         from dispatches_tpu.serve.service import ServeOptions, SolveService
 
         self.model = model
@@ -114,6 +116,15 @@ class WorkerHost:
         self._handles: Dict[int, object] = {}
         self._done: Dict[int, dict] = {}
         self._by_rid: Dict[str, int] = {}
+        # request id → router-side origin identity (from the wire trace
+        # context); trace_export stamps it back onto serve.* spans so
+        # worker-side events carry the router's request identity
+        self._origin: Dict[int, dict] = {}
+        if obs_distributed.enabled():
+            # workers record spans whenever wire tracing is armed, so a
+            # later trace_export pull has a ring to drain
+            obs_trace.enable(True)
+            obs_distributed.set_generation(self.service.generation)
         self._tick_ms = float(tick_ms)
         self._pump: Optional[threading.Thread] = None
         self._running = False
@@ -124,6 +135,8 @@ class WorkerHost:
             "flush": self._rpc_flush,
             "drain": self._rpc_drain,
             "metrics": self._rpc_metrics,
+            "metrics_snapshot": self._rpc_metrics_snapshot,
+            "trace_export": self._rpc_trace_export,
             "gossip_donate": self._rpc_gossip_donate,
             "gossip_merge": self._rpc_gossip_merge,
         }, host=host, port=port)
@@ -185,11 +198,16 @@ class WorkerHost:
     # -- handlers (each runs on an RPC connection thread) -------------------
 
     def _rpc_hello(self, payload) -> dict:
+        from dispatches_tpu.obs import trace as obs_trace
+
         opts = self.service.options
         return {
             "pid": os.getpid(),
             "model": self.model,
             "generation": self.service.generation,
+            # monotonic tracer-clock sample: the client brackets hello
+            # with now_us() reads and midpoints a clock-offset estimate
+            "now_us": obs_trace.now_us(),
             "journal_dir": self.journal_dir,
             "options": {
                 "max_batch": opts.max_batch,
@@ -221,9 +239,14 @@ class WorkerHost:
             deadline_ms=payload.get("deadline_ms"),
             warm_key=payload.get("warm_key"),
             base_solver=self.base_solver)
+        origin = self._submit_origin(rid)
         with self._lock:
             if rid is not None:
                 self._by_rid[rid] = handle.request_id
+            if origin is not None:
+                self._origin[handle.request_id] = origin
+                while len(self._origin) > 4096:  # bounded, oldest out
+                    self._origin.pop(next(iter(self._origin)))
             if handle.done():
                 # completed at submit (shed / expired): straight to the
                 # done-buffer, no handle to track
@@ -268,8 +291,68 @@ class WorkerHost:
                 "snapshot": out.get("snapshot"),
                 "done": done}
 
+    @staticmethod
+    def _submit_origin(rid) -> Optional[dict]:
+        """Router-side identity of the submit being handled, decoded by
+        the RPC layer from the frame's trace context (None when wire
+        tracing is disarmed)."""
+        from dispatches_tpu.obs import distributed as obs_distributed
+
+        if not obs_distributed.enabled():
+            return None
+        ctx = obs_distributed.current()
+        if ctx is None:
+            return None
+        return {"rid": ctx.rid if ctx.rid is not None else rid,
+                "pid": ctx.pid, "gen": ctx.gen}
+
     def _rpc_metrics(self, payload) -> dict:
         return self.service.metrics()
+
+    def _rpc_metrics_snapshot(self, payload) -> dict:
+        """Full registry snapshot for the fleet telemetry rollup —
+        plain dicts, so it crosses the journal codec untouched."""
+        from dispatches_tpu.obs import registry as obs_registry
+        from dispatches_tpu.obs import trace as obs_trace
+
+        return {
+            "pid": os.getpid(),
+            "generation": self.service.generation,
+            "now_us": obs_trace.now_us(),
+            "snapshot": obs_registry.default_registry().snapshot(),
+        }
+
+    def _rpc_trace_export(self, payload) -> dict:
+        """Tail of the local trace ring for the fleet trace merger.
+        Spans whose ``request_id`` the worker has an origin record for
+        are annotated with the router-side identity, so the merged
+        trace shows one journey, not two disconnected ids."""
+        from dispatches_tpu.obs import trace as obs_trace
+
+        limit = int((payload or {}).get("limit") or 0)
+        evts = obs_trace.events()
+        if limit > 0:
+            evts = evts[-limit:]
+        with self._lock:
+            origin = dict(self._origin)
+        out = []
+        for e in evts:
+            args = e.get("args") or {}
+            o = origin.get(args.get("request_id"))
+            if o is not None:
+                e = dict(e)
+                args = dict(args)
+                args["origin_rid"] = o["rid"]
+                args["origin_pid"] = o["pid"]
+                e["args"] = args
+            out.append(e)
+        return {
+            "pid": os.getpid(),
+            "generation": self.service.generation,
+            "now_us": obs_trace.now_us(),
+            "dropped": obs_trace.dropped(),
+            "events": out,
+        }
 
     def _rpc_gossip_donate(self, payload) -> dict:
         from dispatches_tpu.fleet import gossip as gossip_mod

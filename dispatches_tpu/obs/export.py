@@ -47,6 +47,7 @@ __all__ = [
     "enabled",
     "process_start_us",
     "render_prometheus",
+    "render_prometheus_snapshots",
     "set_restart_generation",
     "PROM_FILE",
 ]
@@ -193,6 +194,67 @@ def render_prometheus(registry: Optional[obs_registry.MetricsRegistry]
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def _parse_label_text(text: str) -> List:
+    """Inverse of ``registry.label_text`` for snapshot keys (''= no
+    labels); values never contain commas or '=' in this codebase's
+    label vocabulary (method/bucket/replica/peer names)."""
+    if not text:
+        return []
+    pairs = []
+    for part in text.split(","):
+        k, _, v = part.partition("=")
+        pairs.append((k, v))
+    return pairs
+
+
+def render_prometheus_snapshots(per_process: Dict[str, Dict]) -> str:
+    """Registry *snapshots* — typically pulled from other processes via
+    the ``metrics_snapshot`` worker RPC — as Prometheus text, every
+    series labeled ``process="<label>"``.
+
+    Same determinism contract as :func:`render_prometheus` (metrics
+    sorted by name, series by label set); histograms render their
+    windowed quantiles and count (a snapshot carries no exact sum, so
+    no ``_sum`` series).  Snapshots carry no help strings, so only
+    ``# TYPE`` headers are emitted — the local render above them
+    already documents shared families.
+    """
+    by_name: Dict[str, Dict] = {}
+    for process in sorted(per_process):
+        snap = per_process[process] or {}
+        for name, entry in sorted(snap.items()):
+            if not isinstance(entry, dict) or "kind" not in entry:
+                continue
+            slot = by_name.setdefault(name, {"kind": entry["kind"],
+                                             "series": []})
+            for label, val in sorted((entry.get("values") or {}).items()):
+                pairs = ([("process", process)]
+                         + _parse_label_text(label))
+                slot["series"].append((pairs, val))
+    lines: List[str] = []
+    for name in sorted(by_name):
+        entry = by_name[name]
+        pname = _prom_name(name)
+        kind = entry["kind"]
+        if kind == "histogram":
+            lines.append(f"# TYPE {pname} summary")
+            for pairs, summary in entry["series"]:
+                for q, field in (("0.5", "p50"), ("0.95", "p95"),
+                                 ("0.99", "p99")):
+                    if field in summary:
+                        lines.append(
+                            f"{pname}{_labels_text(pairs, [('quantile', q)])}"
+                            f" {_fmt(summary[field])}")
+                lines.append(f"{pname}_count{_labels_text(pairs)}"
+                             f" {_fmt(summary.get('count', 0))}")
+        else:
+            lines.append(f"# TYPE {pname} {kind}")
+            for pairs, val in entry["series"]:
+                lines.append(f"{pname}{_labels_text(pairs)}"
+                             f" {_fmt(0.0 if val is None else val)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 # ---------------------------------------------------------------------
 # periodic JSONL time series
 # ---------------------------------------------------------------------
@@ -210,7 +272,9 @@ class ContinuousExporter:
 
     def __init__(self, options: Optional[ExportOptions] = None, *,
                  clock: Callable[[], float] = time.monotonic,
-                 registry: Optional[obs_registry.MetricsRegistry] = None):
+                 registry: Optional[obs_registry.MetricsRegistry] = None,
+                 fleet_snapshots: Optional[
+                     Callable[[], Dict[str, Dict]]] = None):
         self.options = (options if options is not None
                         else ExportOptions.from_env())
         if not self.options.directory:
@@ -220,6 +284,11 @@ class ContinuousExporter:
         self._clock = clock
         self._registry = (obs_registry.default_registry()
                           if registry is None else registry)
+        # fleet mode: a provider returning {process_label: registry
+        # snapshot} (the FleetRouter pulls live remote replicas); each
+        # metrics.prom rewrite merges those series, process-labeled,
+        # after the local render
+        self._fleet_snapshots = fleet_snapshots
         self._last: Optional[float] = None
         self._seq = 0
         self._file_idx = 1
@@ -312,9 +381,17 @@ class ContinuousExporter:
     def _write_prom(self) -> None:
         # appended after the registry render (not inside it) so the
         # byte-pinned render_prometheus golden stays untouched
+        remote = ""
+        if self._fleet_snapshots is not None:
+            try:
+                per = self._fleet_snapshots() or {}
+                remote = render_prometheus_snapshots(per)
+            except Exception:
+                remote = ""  # a dead worker must not stop local export
         name = "dispatches_tpu_process_start_us"
         text = (
             render_prometheus(self._registry)
+            + remote
             + f"# HELP {name} process start timestamp (us since epoch);"
             " the generation label increments on journal/snapshot"
             " recovery\n"

@@ -457,8 +457,10 @@ class DriftDetector:
 # incremental pipeline timeline
 # ---------------------------------------------------------------------------
 
-# edge kinds in the sweep: host (stage/submit union) and inflight
-_HOST, _INFLIGHT = 0, 1
+# edge kinds in the sweep: host (stage/submit union), inflight, and
+# wire (client-side net.rpc spans — zero-depth idle under one is
+# network-starved, not demand-starved)
+_HOST, _INFLIGHT, _WIRE = 0, 1, 2
 
 
 class TimelineAccumulator:
@@ -503,6 +505,7 @@ class TimelineAccumulator:
         self._edges: List[Tuple[float, int, int]] = []  # (t, step, kind)
         self._depth_h = 0
         self._depth_i = 0
+        self._depth_w = 0
         self._prev: Optional[float] = None
         self._t_lo: Optional[float] = None
         self._t_hi: Optional[float] = None
@@ -511,7 +514,8 @@ class TimelineAccumulator:
         self._hidden_us = 0.0
         self._fence_bound_us = 0.0
         self._zero_host_us = 0.0    # depth_i == 0 under a host span
-        self._zero_empty_us = 0.0   # depth_i == 0, host idle
+        self._zero_wire_us = 0.0    # depth_i == 0, host idle, RPC on wire
+        self._zero_empty_us = 0.0   # depth_i == 0, host + wire idle
         self._occupancy: Dict[int, float] = {}
         self._cells = None
 
@@ -525,6 +529,16 @@ class TimelineAccumulator:
         if event.get("ph") != "X":
             return
         name = event.get("name")
+        if name == "net.rpc":
+            # wire spans carry no plan id; they only refine zero-depth
+            # idle into wire_bound vs queue_empty, so they contribute
+            # edges without moving the watermark or the wall window
+            with self._lock:
+                ts = float(event["ts"])
+                end = ts + float(event.get("dur", 0.0))
+                heapq.heappush(self._edges, (ts, +1, _WIRE))
+                heapq.heappush(self._edges, (end, -1, _WIRE))
+            return
         if name not in self.SPAN_NAMES:
             return
         args = event.get("args") or {}
@@ -569,8 +583,10 @@ class TimelineAccumulator:
                 self._prev = t
             if kind == _HOST:
                 self._depth_h += step
-            else:
+            elif kind == _INFLIGHT:
                 self._depth_i += step
+            else:
+                self._depth_w += step
 
     def _accumulate(self, dt: float) -> None:
         occ = self._occupancy
@@ -583,6 +599,8 @@ class TimelineAccumulator:
         if di == 0:
             if self._depth_h > 0:
                 self._zero_host_us += dt
+            elif self._depth_w > 0:
+                self._zero_wire_us += dt
             else:
                 self._zero_empty_us += dt
 
@@ -594,6 +612,7 @@ class TimelineAccumulator:
         between decisions."""
         return {"fence_bound_us": self._fence_bound_us,
                 "host_stage_bound_us": self._zero_host_us,
+                "wire_bound_us": self._zero_wire_us,
                 "queue_empty_us": self._zero_empty_us}
 
     def _figures(self) -> Dict:
@@ -601,7 +620,8 @@ class TimelineAccumulator:
         eff = (self._hidden_us / self._host_us) if self._host_us > 0 else 0.0
         occ_mean = (sum(d * us for d, us in self._occupancy.items()) / wall
                     if wall > 0 else 0.0)
-        stall = self._fence_bound_us + self._zero_host_us + self._zero_empty_us
+        stall = (self._fence_bound_us + self._zero_host_us
+                 + self._zero_wire_us + self._zero_empty_us)
         stall_pct = (100.0 * stall / wall) if wall > 0 else 0.0
         return {"wall": wall, "eff": eff, "occ_mean": occ_mean,
                 "stall_pct": stall_pct}
@@ -628,6 +648,7 @@ class TimelineAccumulator:
             "stall": {
                 "fence_bound_us": round(self._fence_bound_us, 1),
                 "host_stage_bound_us": round(self._zero_host_us, 1),
+                "wire_bound_us": round(self._zero_wire_us, 1),
                 "queue_empty_us": round(self._zero_empty_us, 1),
                 "stall_pct": round(f["stall_pct"], 2),
             },
@@ -675,4 +696,5 @@ class TimelineAccumulator:
         g.set(round(self._fence_bound_us, 1), kind="fence_bound", **labels)
         g.set(round(self._zero_host_us, 1), kind="host_stage_bound",
               **labels)
+        g.set(round(self._zero_wire_us, 1), kind="wire_bound", **labels)
         g.set(round(self._zero_empty_us, 1), kind="queue_empty", **labels)

@@ -17,6 +17,7 @@ __all__ = [
     "load_chrome_trace",
     "validate_chrome_trace",
     "request_journey",
+    "journey_processes",
 ]
 
 
@@ -146,11 +147,25 @@ def validate_chrome_trace(events: List[Dict]) -> List[str]:
 def request_journey(events: List[Dict], request_id: int) -> List[Dict]:
     """The span events carrying ``args.request_id == request_id``
     (``serve.request`` / ``serve.queue_wait`` / ``serve.dispatch``),
-    ts-sorted — one request's journey out of a full trace."""
-    out = [e for e in events
-           if (e.get("args") or {}).get("request_id") == request_id]
+    ts-sorted — one request's journey out of a full trace.  In a
+    merged multi-process trace, worker-exported spans annotated with
+    the router-side ``origin_rid`` (``obs.distributed``) join the same
+    journey."""
+    def _matches(e: Dict) -> bool:
+        args = e.get("args") or {}
+        return (args.get("request_id") == request_id
+                or args.get("origin_rid") == request_id)
+
+    out = [e for e in events if _matches(e)]
     out.sort(key=lambda e: (e.get("ts", 0.0), e.get("name", "")))
     return out
+
+
+def journey_processes(events: List[Dict], request_id: int) -> List[int]:
+    """Distinct pids contributing spans to one request's journey in a
+    merged trace — ≥ 2 proves the journey crossed a process boundary."""
+    return sorted({e.get("pid") for e in request_journey(events, request_id)
+                   if e.get("pid") is not None})
 
 
 def load_chrome_trace(path) -> List[Dict]:

@@ -16,8 +16,15 @@ three numbers the dispatch-ahead design is accountable for:
 * **stall attribution** — wall time lost to ``fence_bound`` (host
   blocked on the device), ``host_stage_bound`` (nothing in flight
   while the host staged/dispatched — the device waited on the host),
-  and ``queue_empty`` (nothing in flight and nothing staged — the
-  pipeline was starved).
+  ``wire_bound`` (nothing in flight or staged but an RPC was on the
+  wire — the pipeline was starved by the network, not by demand), and
+  ``queue_empty`` (nothing in flight, staged, or on the wire — the
+  pipeline was genuinely starved).
+
+In a merged multi-process trace (``obs.distributed``), pass
+``local_pid`` so batches are tagged ``placement: host_local`` vs
+``cross_process`` and only the local process's ``net.rpc`` client
+spans count toward ``wire_bound``.
 
 ``python -m dispatches_tpu.obs --timeline [--json]`` renders it;
 :func:`counter_events` adds a ``plan.inflight`` counter track to the
@@ -83,13 +90,56 @@ def _overlap(span: Tuple[float, float],
                for m_lo, m_hi in merged)
 
 
+def _subtract(spans: List[Tuple[float, float]],
+              merged: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Parts of ``spans`` not covered by ``merged`` (both half-open;
+    ``merged`` must already be sorted/coalesced via :func:`_merge`)."""
+    out: List[Tuple[float, float]] = []
+    for lo, hi in spans:
+        cur = lo
+        for m_lo, m_hi in merged:
+            if m_hi <= cur:
+                continue
+            if m_lo >= hi:
+                break
+            if m_lo > cur:
+                out.append((cur, m_lo))
+            cur = max(cur, m_hi)
+            if cur >= hi:
+                break
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def _wire_spans(events: List[Dict],
+                local_pid: Optional[int]) -> List[Tuple[float, float]]:
+    """Client-side RPC wall intervals (``net.rpc`` complete spans).
+    In a merged trace, ``local_pid`` restricts to the local process's
+    own calls — remote workers' RPCs don't stall this pipeline."""
+    out: List[Tuple[float, float]] = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != "net.rpc":
+            continue
+        pid = e.get("pid")
+        if local_pid is not None and pid is not None and pid != local_pid:
+            continue
+        ts = float(e["ts"])
+        out.append((ts, ts + float(e.get("dur", 0.0))))
+    return out
+
+
 def build_timeline(events: List[Dict],
-                   plan: Optional[int] = None) -> Optional[Dict]:
+                   plan: Optional[int] = None,
+                   local_pid: Optional[int] = None) -> Optional[Dict]:
     """Reconstruct one plan's batch timeline from trace events.
 
     ``plan`` selects the pipeline when the trace interleaves several;
-    None picks the plan with the most submitted batches.  Returns None
-    when the events carry no plan lifecycle spans.
+    None picks the plan with the most submitted batches.  ``local_pid``
+    identifies "this" process in a merged multi-process trace — it
+    drives per-batch ``placement`` tagging and restricts wire-stall
+    accounting to local RPC spans.  Returns None when the events carry
+    no plan lifecycle spans.
     """
     if plan is None:
         ids = plan_ids(events)
@@ -113,7 +163,8 @@ def build_timeline(events: List[Dict],
         if e["name"] == "plan.stage":
             stage_spans.append((ts, ts + dur))
         elif e["name"] == "plan.submit":
-            submits[args["seq"]] = {"t0": ts, "t1": ts + dur, "args": args}
+            submits[args["seq"]] = {"t0": ts, "t1": ts + dur, "args": args,
+                                    "pid": e.get("pid")}
         elif e["name"] == "plan.fence":
             fences[args["seq"]] = {"t0": ts, "t1": ts + dur, "args": args}
     if not submits:
@@ -132,6 +183,10 @@ def build_timeline(events: List[Dict],
         sub, fen = submits[seq], fences.get(seq)
         a = sub["args"]
         fence_end = fen["t1"] if fen is not None else t_hi
+        sub_pid = sub.get("pid")
+        placement = ("cross_process"
+                     if local_pid is not None and sub_pid is not None
+                     and sub_pid != local_pid else "host_local")
         # in flight = dispatched (host returned from submit) until the
         # fence observed device completion; an unfenced batch counts to
         # the end of the trace window
@@ -152,6 +207,7 @@ def build_timeline(events: List[Dict],
                               else round(fen["t1"] - fen["t0"], 1)),
             "span_us": round(fence_end - sub["t0"], 1),
             "inflight_after_submit": a.get("inflight"),
+            "placement": placement,
             # retirement rank from the plan's fence counter: under
             # schedule="ready" it can disagree with seq (out-of-order
             # fence); None for unfenced batches / pre-PR-14 traces
@@ -199,14 +255,19 @@ def build_timeline(events: List[Dict],
                       if wall_us > 0 else 0.0)
 
     # -- stall attribution.  Fence waits happen at depth >= 1 (the
-    # fencing batch is still in flight), so the three buckets never
-    # double-count wall time.
+    # fencing batch is still in flight), so the buckets never
+    # double-count wall time: zero-depth idle is split host-staged vs
+    # wire-bound vs truly empty by interval subtraction.
     fence_bound_us = sum(f["t1"] - f["t0"] for f in fences.values())
     merged_host = _merge(host_spans)
     host_stage_bound_us = sum(_overlap(z, merged_host) for z in zero_spans)
-    queue_empty_us = (sum(hi - lo for lo, hi in zero_spans)
-                      - host_stage_bound_us)
-    stall_us = fence_bound_us + host_stage_bound_us + queue_empty_us
+    pure_idle = _subtract(zero_spans, merged_host)
+    merged_wire = _merge(_wire_spans(events, local_pid))
+    wire_bound_us = sum(_overlap(z, merged_wire) for z in pure_idle)
+    queue_empty_us = (sum(hi - lo for lo, hi in pure_idle)
+                      - wire_bound_us)
+    stall_us = (fence_bound_us + host_stage_bound_us + wire_bound_us
+                + queue_empty_us)
     stall_pct = (100.0 * stall_us / wall_us) if wall_us > 0 else 0.0
 
     return {
@@ -224,6 +285,7 @@ def build_timeline(events: List[Dict],
         "stall": {
             "fence_bound_us": round(fence_bound_us, 1),
             "host_stage_bound_us": round(host_stage_bound_us, 1),
+            "wire_bound_us": round(wire_bound_us, 1),
             "queue_empty_us": round(queue_empty_us, 1),
             "stall_pct": round(stall_pct, 2),
         },
@@ -297,6 +359,7 @@ def format_timeline(tl: Optional[Dict]) -> str:
         f"stalls: {st['stall_pct']:.1f}% of wall  "
         f"[fence-bound {st['fence_bound_us'] / 1e3:.3f} ms, "
         f"host-stage-bound {st['host_stage_bound_us'] / 1e3:.3f} ms, "
+        f"wire-bound {st.get('wire_bound_us', 0.0) / 1e3:.3f} ms, "
         f"queue-empty {st['queue_empty_us'] / 1e3:.3f} ms]")
     lines.append("batches (seq: dispatch->fence, fence wait, requests):")
     for b in tl["batches"]:

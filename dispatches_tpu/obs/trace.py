@@ -37,6 +37,7 @@ __all__ = [
     "enabled",
     "enable",
     "span",
+    "current_span",
     "instant",
     "complete",
     "now_us",
@@ -238,6 +239,16 @@ def span(name: str, **args):
     if not enabled():
         return _NULL_SPAN
     return Span(name, args)
+
+
+def current_span() -> Optional[str]:
+    """Name of the innermost span open in this context, or None.
+
+    The distributed-tracing layer stamps this into the wire context of
+    outbound RPCs so a worker-side child span can name its router-side
+    parent without the two processes sharing a contextvar."""
+    stack = _STACK.get()
+    return stack[-1] if stack else None
 
 
 def instant(name: str, **args) -> None:

@@ -759,16 +759,30 @@ class SolveService:
             bucket = _Bucket(nlp, solver, opts, label, self.plan,
                              warm_start=warm)
             bucket.rebuild = (nlp, solver, dict(opts), warm)
-            self._buckets[key] = bucket
-            # recovery: a restored snapshot stashed learned state under
-            # this label (the only bucket identity that survives a
-            # process) — apply it before the bucket sees traffic
-            restored = self._restored_buckets.pop(label, None)
-            if restored is not None:
-                try:
-                    snapshot_mod.apply_bucket_state(bucket, restored)
-                except Exception:
-                    pass  # a stale snapshot must never block serving
+            # double-checked insert: two first-submit threads can both
+            # miss and build — an unconditional write would orphan the
+            # loser's pending deque (its requests would never flush).
+            # Construction stays outside the lock (it may compile);
+            # the loser's twin is discarded before it sees traffic.
+            inserted = False
+            with self._lock:
+                raced = self._buckets.get(key)
+                if raced is not None and raced.nlp is nlp:
+                    bucket = raced
+                else:
+                    self._buckets[key] = bucket
+                    inserted = True
+            if inserted:
+                # recovery: a restored snapshot stashed learned state
+                # under this label (the only bucket identity that
+                # survives a process) — apply it before the bucket
+                # sees traffic
+                restored = self._restored_buckets.pop(label, None)
+                if restored is not None:
+                    try:
+                        snapshot_mod.apply_bucket_state(bucket, restored)
+                    except Exception:
+                        pass  # a stale snapshot must never block serving
         # degradation rung 2 (bf16→f32) leaves a redirect on the
         # original bucket: new submissions follow it, in-flight
         # requests finish on the program they were queued for
@@ -995,7 +1009,7 @@ class SolveService:
         # per-poll cost everywhere else is the O(1) due() gate, and the
         # cadence is bounded (at most one refit per refit_every
         # completed results per bucket)
-        for bucket in self._buckets.values():
+        for bucket in list(self._buckets.values()):
             trainer = bucket.predict_trainer
             if (trainer is None or bucket.predict_fallback
                     or not trainer.due()):
@@ -1094,13 +1108,15 @@ class SolveService:
         return sorted(buckets, key=slack)
 
     def _queue_depth(self) -> int:
-        return sum(len(b.pending) for b in self._buckets.values())
+        # list() snapshot: a concurrent first-submit may insert a
+        # bucket mid-iteration (dict mutation during genexp raises)
+        return sum(len(b.pending) for b in list(self._buckets.values()))
 
     def _flush_oldest(self) -> int:
         """Backpressure relief: flush the bucket holding the oldest
         pending request (oldest-first policy)."""
         oldest = None
-        for bucket in self._buckets.values():
+        for bucket in list(self._buckets.values()):
             if bucket.pending and (
                     oldest is None
                     or bucket.pending[0].submitted_at
